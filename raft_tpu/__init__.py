@@ -1,0 +1,16 @@
+"""raft_tpu — a TPU-native (JAX/XLA/Pallas) optical-flow framework.
+
+Re-designed from scratch with the capabilities of the LRLVEC/RAFT reference
+(RAFT: Recurrent All-Pairs Field Transforms, ECCV 2020) but built TPU-first:
+
+- NHWC layouts, bfloat16 mixed precision with fp32 correlation islands
+- functional core: pure ``apply(params, batch)`` over pytrees
+- ``lax.scan`` recurrent refinement, static shapes, jit-compiled end to end
+- SPMD data/spatial parallelism via ``jax.sharding.Mesh`` + XLA collectives
+- Pallas kernels for the correlation-lookup hot path
+- AOT-compiled serving engine (the TensorRT-path analog)
+"""
+
+__version__ = "0.1.0"
+
+from raft_tpu.config import RAFTConfig  # noqa: F401

@@ -1,0 +1,147 @@
+"""Typed configuration for raft_tpu.
+
+The reference threads a *mutable* argparse Namespace through every layer and
+lets the model write ``corr_levels``/``corr_radius`` back into it
+(``/root/reference/core/raft.py:29-45``, ``core/update.py:65,82``).  Here the
+config is a frozen dataclass: model presets own their constants, stage presets
+mirror the shell-script curricula (``train_standard.sh``/``train_mixed.sh``),
+and nothing is mutated downstream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RAFTConfig:
+    """Architecture hyper-parameters.
+
+    Constants mirror the reference parity surface (SURVEY.md §2):
+    basic: hdim=cdim=128, corr_levels=4, corr_radius=4, fnet 256ch instance
+    norm, cnet 256ch batch norm (``core/raft.py:36-39,54-55``);
+    small: hdim=96, cdim=64, radius=3, fnet 128 instance, cnet 160 no-norm
+    (``core/raft.py:30-33,49-50``).
+    """
+
+    small: bool = False
+    dropout: float = 0.0
+    alternate_corr: bool = False
+    mixed_precision: bool = False
+    corr_levels: int = 4
+
+    @property
+    def hidden_dim(self) -> int:
+        return 96 if self.small else 128
+
+    @property
+    def context_dim(self) -> int:
+        return 64 if self.small else 128
+
+    @property
+    def corr_radius(self) -> int:
+        return 3 if self.small else 4
+
+    @property
+    def fnet_dim(self) -> int:
+        return 128 if self.small else 256
+
+    @property
+    def cnet_dim(self) -> int:
+        return self.hidden_dim + self.context_dim
+
+    @property
+    def fnet_norm(self) -> str:
+        return "instance"
+
+    @property
+    def cnet_norm(self) -> str:
+        return "none" if self.small else "batch"
+
+    @property
+    def corr_planes(self) -> int:
+        return self.corr_levels * (2 * self.corr_radius + 1) ** 2
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.mixed_precision else jnp.float32
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """One curriculum stage. Defaults follow ``train.py:217-239``."""
+
+    name: str = "raft"
+    stage: str = "chairs"
+    restore_ckpt: Optional[str] = None
+    lr: float = 4e-4
+    num_steps: int = 100000
+    batch_size: int = 10
+    image_size: Tuple[int, int] = (368, 496)
+    wdecay: float = 1e-4
+    epsilon: float = 1e-8
+    clip: float = 1.0
+    gamma: float = 0.8
+    iters: int = 12
+    add_noise: bool = False
+    seed: int = 1234
+    val_freq: int = 5000
+    sum_freq: int = 100
+    validation: Tuple[str, ...] = ()
+    # TPU-specific
+    num_workers: int = 4
+    checkpoint_dir: str = "checkpoints"
+    data_root: str = "datasets"
+    log_dir: str = "runs"
+
+
+# Stage presets mirroring train_standard.sh:3-6 (2-GPU fp32 recipe).
+STANDARD_STAGES = {
+    "chairs": dict(stage="chairs", lr=4e-4, num_steps=100000, batch_size=10,
+                   image_size=(368, 496), wdecay=1e-4, gamma=0.8,
+                   validation=("chairs",)),
+    "things": dict(stage="things", lr=1.25e-4, num_steps=100000, batch_size=6,
+                   image_size=(400, 720), wdecay=1e-4, gamma=0.8,
+                   validation=("sintel",)),
+    "sintel": dict(stage="sintel", lr=1.25e-4, num_steps=100000, batch_size=6,
+                   image_size=(368, 768), wdecay=1e-5, gamma=0.85,
+                   validation=("sintel",)),
+    "kitti": dict(stage="kitti", lr=1e-4, num_steps=50000, batch_size=6,
+                  image_size=(288, 960), wdecay=1e-5, gamma=0.85,
+                  validation=("kitti",)),
+}
+
+# Stage presets mirroring train_mixed.sh:3-6 (1-GPU mixed-precision recipe).
+MIXED_STAGES = {
+    "chairs": dict(stage="chairs", lr=2.5e-4, num_steps=120000, batch_size=8,
+                   image_size=(368, 496), wdecay=1e-4, gamma=0.8,
+                   validation=("chairs",)),
+    "things": dict(stage="things", lr=1e-4, num_steps=120000, batch_size=5,
+                   image_size=(400, 720), wdecay=1e-4, gamma=0.8,
+                   validation=("sintel",)),
+    "sintel": dict(stage="sintel", lr=1e-4, num_steps=120000, batch_size=5,
+                   image_size=(368, 768), wdecay=1e-5, gamma=0.85,
+                   validation=("sintel",)),
+    "kitti": dict(stage="kitti", lr=1e-4, num_steps=50000, batch_size=5,
+                  image_size=(288, 960), wdecay=1e-5, gamma=0.85,
+                  validation=("kitti",)),
+}
+
+# Iteration counts per use-site (BASELINE.md): train 12, demo 20,
+# eval sintel 32 / kitti 24 / chairs 24, export bakes 20.
+ITERS_TRAIN = 12
+ITERS_DEMO = 20
+ITERS_EVAL = {"sintel": 32, "kitti": 24, "chairs": 24}
+ITERS_EXPORT = 20
+
+MAX_FLOW = 400.0  # train.py:42 — exclude extreme displacements from the loss
+
+
+def stage_config(stage: str, mixed: bool = False, **overrides) -> TrainConfig:
+    presets = MIXED_STAGES if mixed else STANDARD_STAGES
+    kw = dict(presets[stage])
+    kw.update(overrides)
+    return TrainConfig(**kw)

@@ -1,0 +1,13 @@
+from raft_tpu.ops.sampling import (  # noqa: F401
+    bilinear_sampler,
+    coords_grid,
+    grid_sample_nhwc,
+)
+from raft_tpu.ops.flow_ops import (  # noqa: F401
+    convex_upsample,
+    initialize_flow,
+    upflow8,
+    resize_bilinear_align_corners,
+)
+from raft_tpu.ops.padding import InputPadder, pad_to_multiple, unpad  # noqa: F401
+from raft_tpu.ops.pooling import avg_pool2x2  # noqa: F401

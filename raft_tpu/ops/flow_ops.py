@@ -1,0 +1,84 @@
+"""Flow-field ops: initialization, upsampling, convex upsampling (NHWC).
+
+Equivalents of ``core/raft.py:63-83`` and ``core/utils/utils.py:80-82``, laid
+out channels-last and expressed as einsums so XLA can fuse/tile them for the
+MXU/VPU instead of the unfold+view dance the reference does.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops.sampling import coords_grid, grid_sample_nhwc
+
+
+def initialize_flow(batch: int, ht: int, wd: int):
+    """coords0, coords1 at 1/8 resolution; flow = coords1 - coords0.
+
+    Analog of ``core/raft.py:63-70`` (inputs already divided by 8 here).
+    """
+    coords0 = coords_grid(batch, ht, wd)
+    coords1 = coords_grid(batch, ht, wd)
+    return coords0, coords1
+
+
+def resize_bilinear_align_corners(x: jax.Array, out_hw) -> jax.Array:
+    """Bilinear resize with align_corners=True semantics, NHWC.
+
+    ``jax.image.resize`` uses half-pixel centers, which does NOT match
+    ``F.interpolate(..., align_corners=True)`` (``core/utils/utils.py:82``);
+    align_corners maps output i -> input i*(H_in-1)/(H_out-1), so we sample
+    explicitly.
+    """
+    B, H, W, C = x.shape
+    oh, ow = out_hw
+    sy = (H - 1) / (oh - 1) if oh > 1 else 0.0
+    sx = (W - 1) / (ow - 1) if ow > 1 else 0.0
+    ys = jnp.arange(oh, dtype=jnp.float32) * sy
+    xs = jnp.arange(ow, dtype=jnp.float32) * sx
+    gx, gy = jnp.meshgrid(xs, ys, indexing="xy")
+    gx = jnp.broadcast_to(gx[None], (B, oh, ow))
+    gy = jnp.broadcast_to(gy[None], (B, oh, ow))
+    return grid_sample_nhwc(x, gx, gy)
+
+
+def upflow8(flow: jax.Array) -> jax.Array:
+    """8x bilinear upsample of a (B, H, W, 2) flow field, scaling values by 8.
+
+    Analog of ``core/utils/utils.py:80-82``; used by the small model, which
+    has no learned upsampling mask (``core/raft.py:134-135``).
+    """
+    B, H, W, _ = flow.shape
+    return 8.0 * resize_bilinear_align_corners(flow, (8 * H, 8 * W))
+
+
+def convex_upsample(flow: jax.Array, mask: jax.Array) -> jax.Array:
+    """Learned convex-combination 8x upsample. flow (B,H,W,2), mask (B,H,W,576).
+
+    Analog of ``core/raft.py:72-83``. The 576 mask channels factor as
+    (9 neighbors, 8 sub-rows, 8 sub-cols) in C-order — i.e. channel
+    c = k*64 + i*8 + j — matching ``mask.view(N, 1, 9, 8, 8, H, W)``; the 9
+    neighbors enumerate the 3x3 window row-major ((dy,dx) = (-1,-1)..(1,1)),
+    matching ``F.unfold(8*flow, [3,3], padding=1)``. Output pixel
+    (8h+i, 8w+j) = sum_k softmax(mask)[k,i,j] * 8*flow[h+dy_k, w+dx_k].
+    """
+    B, H, W, _ = flow.shape
+    mask = mask.reshape(B, H, W, 9, 8, 8).astype(jnp.float32)
+    mask = jax.nn.softmax(mask, axis=3)
+
+    # 3x3 neighborhood of 8*flow, zero-padded (F.unfold pads with zeros).
+    fp = jnp.pad(8.0 * flow.astype(jnp.float32),
+                 ((0, 0), (1, 1), (1, 1), (0, 0)))
+    neighbors = jnp.stack(
+        [fp[:, dy:dy + H, dx:dx + W, :] for dy in range(3) for dx in range(3)],
+        axis=3,
+    )  # (B, H, W, 9, 2)
+
+    # fp32 island: default matmul precision is bf16-class on TPU; the convex
+    # combination must stay exact (reference computes it outside autocast).
+    up = jnp.einsum("bhwkij,bhwkc->bhwijc", mask, neighbors,
+                    precision=jax.lax.Precision.HIGHEST)
+    # (B, H, W, 8, 8, 2) -> (B, 8H, 8W, 2)
+    up = up.transpose(0, 1, 3, 2, 4, 5)
+    return up.reshape(B, 8 * H, 8 * W, 2)

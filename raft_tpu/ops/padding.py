@@ -1,0 +1,53 @@
+"""Input padding to stride-8 alignment (NHWC).
+
+Equivalent of ``core/utils/utils.py:7-24`` (class form) and
+``raft_trt_utils.py:8-21`` (functional form). Padding is replicate-edge;
+'sintel' centers the pad, 'kitti' pads only the bottom (``utils.py:16`` —
+F.pad's height pair is (top=0, bottom=pad_ht)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_amounts(ht: int, wd: int, mode: str = "sintel"):
+    pad_ht = (((ht // 8) + 1) * 8 - ht) % 8
+    pad_wd = (((wd // 8) + 1) * 8 - wd) % 8
+    if mode == "sintel":
+        # (left, right, top, bottom)
+        return (pad_wd // 2, pad_wd - pad_wd // 2, pad_ht // 2, pad_ht - pad_ht // 2)
+    return (pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht)
+
+
+class InputPadder:
+    """Pads NHWC images so H and W are divisible by 8."""
+
+    def __init__(self, dims, mode: str = "sintel"):
+        # dims: a shape tuple (..., H, W, C) — NHWC.
+        self.ht, self.wd = dims[-3], dims[-2]
+        self._pad = _pad_amounts(self.ht, self.wd, mode)
+
+    def pad(self, *inputs):
+        l, r, t, b = self._pad
+        out = [jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)), mode="edge")
+               for x in inputs]
+        return out if len(out) > 1 else out[0]
+
+    def unpad(self, x: jax.Array) -> jax.Array:
+        l, r, t, b = self._pad
+        ht, wd = x.shape[-3], x.shape[-2]
+        return x[..., t:ht - b, l:wd - r, :]
+
+
+def pad_to_multiple(images: jax.Array, mode: str = "sintel"):
+    """Functional pad (``raft_trt_utils.py:8-14`` analog). Returns (padded, pad)."""
+    padder = InputPadder(images.shape, mode)
+    return padder.pad(images), padder._pad
+
+
+def unpad(x: jax.Array, pad) -> jax.Array:
+    l, r, t, b = pad
+    ht, wd = x.shape[-3], x.shape[-2]
+    return x[..., t:ht - b, l:wd - r, :]

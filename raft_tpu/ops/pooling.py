@@ -1,0 +1,24 @@
+"""Pooling helpers (NHWC)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def avg_pool2x2(x: jax.Array) -> jax.Array:
+    """2x2 stride-2 average pool over the two middle dims of (..., H, W, C).
+
+    Matches ``F.avg_pool2d(x, 2, stride=2)`` (floor division of odd sizes —
+    trailing row/col dropped), used for the correlation pyramid
+    (``core/corr.py:25-27``).
+    """
+    ones = (1,) * (x.ndim - 3)
+    summed = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=ones + (2, 2, 1),
+        window_strides=ones + (2, 2, 1),
+        padding="VALID",
+    )
+    return summed * 0.25

@@ -1,0 +1,74 @@
+"""Bilinear sampling primitives (NHWC, TPU-first).
+
+The reference wraps ``torch.nn.functional.grid_sample`` with pixel-coordinate
+inputs (``/root/reference/core/utils/utils.py:57-71``, align_corners=True,
+zero padding).  TPUs have no grid_sample primitive and pointwise gathers are
+the weak spot, so this implements sampling as *flattened-index gathers* with
+manual corner weights — a form XLA lowers to efficient dynamic-gathers — and
+keeps everything channels-last so the channel dim rides the 128-wide lane
+dimension of the VPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def coords_grid(batch: int, ht: int, wd: int, dtype=jnp.float32) -> jax.Array:
+    """Pixel-coordinate grid, shape (batch, ht, wd, 2), last dim (x, y).
+
+    Equivalent of ``core/utils/utils.py:74-77`` (which returns (B,2,H,W) with
+    channel 0 = x); here channels-last.
+    """
+    xs = jnp.arange(wd, dtype=dtype)
+    ys = jnp.arange(ht, dtype=dtype)
+    x, y = jnp.meshgrid(xs, ys, indexing="xy")
+    grid = jnp.stack([x, y], axis=-1)
+    return jnp.broadcast_to(grid[None], (batch, ht, wd, 2))
+
+
+def grid_sample_nhwc(img: jax.Array, x: jax.Array, y: jax.Array) -> jax.Array:
+    """Bilinear sample ``img`` (B, H, W, C) at pixel coords ``x``/``y`` (B, ...).
+
+    Matches ``F.grid_sample(mode='bilinear', padding_mode='zeros',
+    align_corners=True)`` fed pixel coordinates: corners that land outside the
+    image contribute zero but the in-bounds corners keep their bilinear
+    weights. Returns (B, ..., C).
+    """
+    B, H, W, C = img.shape
+    pos_shape = x.shape  # (B, ...)
+    x = x.reshape(B, -1).astype(jnp.float32)
+    y = y.reshape(B, -1).astype(jnp.float32)
+
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    flat = img.reshape(B, H * W, C)
+
+    def corner(xi, yi, w):
+        valid = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xi_c = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yi_c = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        idx = yi_c * W + xi_c  # (B, N)
+        vals = jnp.take_along_axis(flat, idx[..., None], axis=1)  # (B, N, C)
+        w = (w * valid.astype(jnp.float32))[..., None]
+        return vals * w.astype(vals.dtype)
+
+    out = (
+        corner(x0, y0, (1.0 - wx) * (1.0 - wy))
+        + corner(x0 + 1.0, y0, wx * (1.0 - wy))
+        + corner(x0, y0 + 1.0, (1.0 - wx) * wy)
+        + corner(x0 + 1.0, y0 + 1.0, wx * wy)
+    )
+    return out.reshape(*pos_shape, C)
+
+
+def bilinear_sampler(img: jax.Array, coords: jax.Array) -> jax.Array:
+    """Sample ``img`` (B, H, W, C) at ``coords`` (B, ..., 2), last dim (x, y).
+
+    NHWC analog of ``core/utils/utils.py:57-71``.
+    """
+    return grid_sample_nhwc(img, coords[..., 0], coords[..., 1])

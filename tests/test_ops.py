@@ -1,0 +1,141 @@
+"""Golden tests for raft_tpu.ops against PyTorch oracles.
+
+The oracles are torch *primitives* (grid_sample, interpolate, avg_pool2d,
+unfold) — the same primitives the reference model is built from — so passing
+these pins our NHWC ops to the reference's numerics.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from raft_tpu.ops import (
+    InputPadder,
+    avg_pool2x2,
+    bilinear_sampler,
+    convex_upsample,
+    coords_grid,
+    upflow8,
+)
+
+
+def nchw(x_nhwc):
+    return torch.from_numpy(np.asarray(x_nhwc)).permute(0, 3, 1, 2).contiguous()
+
+
+def to_nhwc(t_nchw):
+    return t_nchw.permute(0, 2, 3, 1).numpy()
+
+
+class TestCoordsGrid:
+    def test_matches_meshgrid(self):
+        g = np.asarray(coords_grid(2, 3, 5))
+        assert g.shape == (2, 3, 5, 2)
+        # channel 0 = x (col), channel 1 = y (row)
+        assert np.all(g[0, :, :, 0] == np.arange(5)[None, :])
+        assert np.all(g[0, :, :, 1] == np.arange(3)[:, None])
+        assert np.all(g[0] == g[1])
+
+
+class TestBilinearSampler:
+    @pytest.mark.parametrize("case", ["interior", "edges", "oob"])
+    def test_vs_grid_sample(self, rng, case):
+        B, H, W, C = 2, 13, 17, 6
+        img = rng.randn(B, H, W, C).astype(np.float32)
+        if case == "interior":
+            xs = rng.uniform(0.5, W - 1.5, size=(B, 7, 9))
+            ys = rng.uniform(0.5, H - 1.5, size=(B, 7, 9))
+        elif case == "edges":
+            xs = rng.uniform(-0.49, W - 0.51, size=(B, 7, 9))
+            ys = rng.uniform(-0.49, H - 0.51, size=(B, 7, 9))
+        else:  # far out of bounds
+            xs = rng.uniform(-5, W + 5, size=(B, 7, 9))
+            ys = rng.uniform(-5, H + 5, size=(B, 7, 9))
+        coords = np.stack([xs, ys], axis=-1).astype(np.float32)
+
+        got = np.asarray(bilinear_sampler(jnp.asarray(img), jnp.asarray(coords)))
+
+        # torch oracle: pixel coords -> normalized [-1, 1], align_corners=True
+        timg = nchw(img)
+        gx = 2 * torch.from_numpy(coords[..., 0]) / (W - 1) - 1
+        gy = 2 * torch.from_numpy(coords[..., 1]) / (H - 1) - 1
+        grid = torch.stack([gx, gy], dim=-1)
+        want = to_nhwc(F.grid_sample(timg, grid, align_corners=True))
+
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+class TestUpflow8:
+    def test_vs_interpolate(self, rng):
+        flow = rng.randn(2, 6, 7, 2).astype(np.float32)
+        got = np.asarray(upflow8(jnp.asarray(flow)))
+        want = to_nhwc(
+            8 * F.interpolate(nchw(flow), size=(48, 56), mode="bilinear",
+                              align_corners=True)
+        )
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-5)
+
+
+class TestAvgPool:
+    @pytest.mark.parametrize("hw", [(8, 8), (7, 9), (13, 6)])
+    def test_vs_avg_pool2d(self, rng, hw):
+        H, W = hw
+        x = rng.randn(3, H, W, 5).astype(np.float32)
+        got = np.asarray(avg_pool2x2(jnp.asarray(x)))
+        want = to_nhwc(F.avg_pool2d(nchw(x), 2, stride=2))
+        np.testing.assert_allclose(got, want, atol=1e-6, rtol=1e-6)
+
+    def test_extra_leading_dims(self, rng):
+        x = rng.randn(2, 4, 10, 12, 1).astype(np.float32)
+        got = np.asarray(avg_pool2x2(jnp.asarray(x)))
+        assert got.shape == (2, 4, 5, 6, 1)
+
+
+class TestConvexUpsample:
+    def test_vs_torch_unfold(self, rng):
+        """Oracle reproduces core/raft.py:72-83 from torch primitives."""
+        B, H, W = 2, 5, 6
+        flow = rng.randn(B, H, W, 2).astype(np.float32)
+        mask = rng.randn(B, H, W, 576).astype(np.float32)
+
+        got = np.asarray(convex_upsample(jnp.asarray(flow), jnp.asarray(mask)))
+
+        tflow = nchw(flow)
+        tmask = nchw(mask).view(B, 1, 9, 8, 8, H, W)
+        tmask = torch.softmax(tmask, dim=2)
+        up = F.unfold(8 * tflow, [3, 3], padding=1).view(B, 2, 9, 1, 1, H, W)
+        up = torch.sum(tmask * up, dim=2)
+        up = up.permute(0, 1, 4, 2, 5, 3).reshape(B, 2, 8 * H, 8 * W)
+        want = to_nhwc(up)
+
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+class TestInputPadder:
+    @pytest.mark.parametrize("mode,hw", [("sintel", (436, 1024)),
+                                         ("kitti", (375, 1242)),
+                                         ("sintel", (440, 1024))])
+    def test_pad_unpad_roundtrip(self, rng, mode, hw):
+        H, W = hw
+        img = rng.randn(1, H, W, 3).astype(np.float32)
+        padder = InputPadder(img.shape, mode=mode)
+        padded = padder.pad(jnp.asarray(img))
+        assert padded.shape[1] % 8 == 0 and padded.shape[2] % 8 == 0
+        back = np.asarray(padder.unpad(padded))
+        np.testing.assert_array_equal(back, img)
+
+    def test_matches_torch_replicate(self, rng):
+        img = rng.randn(1, 11, 14, 3).astype(np.float32)
+        padder = InputPadder(img.shape, mode="sintel")
+        got = np.asarray(padder.pad(jnp.asarray(img)))
+        l, r, t, b = padder._pad
+        want = to_nhwc(F.pad(nchw(img), [l, r, t, b], mode="replicate"))
+        np.testing.assert_array_equal(got, want)
+
+    def test_kitti_pads_bottom_only(self):
+        padder = InputPadder((1, 375, 1242, 3), mode="kitti")
+        l, r, t, b = padder._pad
+        assert t == 0 and b == 1
