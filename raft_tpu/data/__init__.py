@@ -1,0 +1,10 @@
+from raft_tpu.data.datasets import (  # noqa: F401
+    HD1K,
+    KITTI,
+    FlowDataset,
+    FlyingChairs,
+    FlyingThings3D,
+    MpiSintel,
+    fetch_dataset,
+)
+from raft_tpu.data.loader import PrefetchLoader, fetch_dataloader  # noqa: F401

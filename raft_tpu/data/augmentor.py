@@ -1,0 +1,304 @@
+"""Photometric + spatial augmentation for dense and sparse flow.
+
+Equivalent of ``/root/reference/core/utils/augmentor.py`` with the same
+probabilities and parameter distributions. torchvision is not a dependency:
+``ColorJitter(brightness, contrast, saturation, hue)`` is re-implemented on
+numpy/PIL — factors drawn U[1-x, 1+x] (hue U[-h, h]) and applied in a random
+permutation order, the same sampling scheme torchvision uses. Differences
+are sub-quantization-level (uint8 rounding order), not distributional.
+
+All randomness flows through an ``np.random.RandomState`` so loader workers
+can reseed deterministically (the reference reseeds per worker process,
+datasets.py:45-51).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import cv2
+
+cv2.setNumThreads(0)
+cv2.ocl.setUseOpenCL(False)
+
+
+def _blend(a: np.ndarray, b: np.ndarray, factor: float) -> np.ndarray:
+    out = factor * a.astype(np.float32) + (1.0 - factor) * b
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def _grayscale(img: np.ndarray) -> np.ndarray:
+    # ITU-R 601-2 luma, the PIL 'L' transform torchvision uses
+    return (0.299 * img[..., 0] + 0.587 * img[..., 1]
+            + 0.114 * img[..., 2]).astype(np.float32)
+
+
+def adjust_brightness(img, factor):
+    return _blend(img, np.zeros_like(img, np.float32), factor)
+
+
+def adjust_contrast(img, factor):
+    mean = _grayscale(img).mean()
+    return _blend(img, mean, factor)
+
+
+def adjust_saturation(img, factor):
+    gray = _grayscale(img)[..., None]
+    return _blend(img, gray, factor)
+
+
+def adjust_hue(img, factor):
+    """factor in [-0.5, 0.5] — fraction of the hue circle (PIL semantics)."""
+    hsv = cv2.cvtColor(img, cv2.COLOR_RGB2HSV)
+    # cv2 uint8 hue range is [0, 180)
+    shift = np.uint8(int(factor * 180.0) % 180)
+    hsv[..., 0] = (hsv[..., 0] + shift) % 180
+    return cv2.cvtColor(hsv, cv2.COLOR_HSV2RGB)
+
+
+class ColorJitter:
+    """torchvision-style jitter: random factors, random op order."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0, hue=0.0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def __call__(self, img: np.ndarray,
+                 rng: np.random.RandomState) -> np.ndarray:
+        ops = []
+        if self.brightness > 0:
+            f = rng.uniform(max(0, 1 - self.brightness), 1 + self.brightness)
+            ops.append(lambda x, f=f: adjust_brightness(x, f))
+        if self.contrast > 0:
+            f = rng.uniform(max(0, 1 - self.contrast), 1 + self.contrast)
+            ops.append(lambda x, f=f: adjust_contrast(x, f))
+        if self.saturation > 0:
+            f = rng.uniform(max(0, 1 - self.saturation), 1 + self.saturation)
+            ops.append(lambda x, f=f: adjust_saturation(x, f))
+        if self.hue > 0:
+            f = rng.uniform(-self.hue, self.hue)
+            ops.append(lambda x, f=f: adjust_hue(x, f))
+        for i in rng.permutation(len(ops)):
+            img = ops[i](img)
+        return img
+
+
+class FlowAugmentor:
+    """Dense-GT augmentation (augmentor.py:15-120)."""
+
+    def __init__(self, crop_size: Tuple[int, int], min_scale: float = -0.2,
+                 max_scale: float = 0.5, do_flip: bool = True,
+                 rng: Optional[np.random.RandomState] = None):
+        self.crop_size = crop_size
+        self.min_scale = min_scale
+        self.max_scale = max_scale
+        self.spatial_aug_prob = 0.8
+        self.stretch_prob = 0.8
+        self.max_stretch = 0.2
+
+        self.do_flip = do_flip
+        self.h_flip_prob = 0.5
+        self.v_flip_prob = 0.1
+
+        self.photo_aug = ColorJitter(0.4, 0.4, 0.4, 0.5 / 3.14)
+        self.asymmetric_color_aug_prob = 0.2
+        self.eraser_aug_prob = 0.5
+
+        self.rng = rng if rng is not None else np.random.RandomState()
+
+    def reseed(self, seed: int):
+        self.rng = np.random.RandomState(seed)
+
+    def color_transform(self, img1, img2):
+        if self.rng.rand() < self.asymmetric_color_aug_prob:
+            img1 = self.photo_aug(img1, self.rng)
+            img2 = self.photo_aug(img2, self.rng)
+        else:
+            stack = np.concatenate([img1, img2], axis=0)
+            stack = self.photo_aug(stack, self.rng)
+            img1, img2 = np.split(stack, 2, axis=0)
+        return img1, img2
+
+    def eraser_transform(self, img1, img2, bounds=(50, 100)):
+        """Occlusion: rectangles of img2 -> mean color (augmentor.py:52-65)."""
+        ht, wd = img1.shape[:2]
+        if self.rng.rand() < self.eraser_aug_prob:
+            mean_color = np.mean(img2.reshape(-1, 3), axis=0)
+            for _ in range(self.rng.randint(1, 3)):
+                x0 = self.rng.randint(0, wd)
+                y0 = self.rng.randint(0, ht)
+                dx = self.rng.randint(bounds[0], bounds[1])
+                dy = self.rng.randint(bounds[0], bounds[1])
+                img2[y0:y0 + dy, x0:x0 + dx, :] = mean_color
+        return img1, img2
+
+    def spatial_transform(self, img1, img2, flow):
+        ht, wd = img1.shape[:2]
+        min_scale = np.maximum(
+            (self.crop_size[0] + 8) / float(ht),
+            (self.crop_size[1] + 8) / float(wd))
+
+        scale = 2 ** self.rng.uniform(self.min_scale, self.max_scale)
+        scale_x = scale_y = scale
+        if self.rng.rand() < self.stretch_prob:
+            scale_x *= 2 ** self.rng.uniform(-self.max_stretch,
+                                             self.max_stretch)
+            scale_y *= 2 ** self.rng.uniform(-self.max_stretch,
+                                             self.max_stretch)
+        scale_x = np.clip(scale_x, min_scale, None)
+        scale_y = np.clip(scale_y, min_scale, None)
+
+        if self.rng.rand() < self.spatial_aug_prob:
+            img1 = cv2.resize(img1, None, fx=scale_x, fy=scale_y,
+                              interpolation=cv2.INTER_LINEAR)
+            img2 = cv2.resize(img2, None, fx=scale_x, fy=scale_y,
+                              interpolation=cv2.INTER_LINEAR)
+            flow = cv2.resize(flow, None, fx=scale_x, fy=scale_y,
+                              interpolation=cv2.INTER_LINEAR)
+            flow = flow * [scale_x, scale_y]
+
+        if self.do_flip:
+            if self.rng.rand() < self.h_flip_prob:
+                img1 = img1[:, ::-1]
+                img2 = img2[:, ::-1]
+                flow = flow[:, ::-1] * [-1.0, 1.0]
+            if self.rng.rand() < self.v_flip_prob:
+                img1 = img1[::-1, :]
+                img2 = img2[::-1, :]
+                flow = flow[::-1, :] * [1.0, -1.0]
+
+        y0 = self.rng.randint(0, img1.shape[0] - self.crop_size[0])
+        x0 = self.rng.randint(0, img1.shape[1] - self.crop_size[1])
+
+        img1 = img1[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        img2 = img2[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        flow = flow[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        return img1, img2, flow
+
+    def __call__(self, img1, img2, flow):
+        img1, img2 = self.color_transform(img1, img2)
+        img1, img2 = self.eraser_transform(img1, img2)
+        img1, img2, flow = self.spatial_transform(img1, img2, flow)
+        return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),
+                np.ascontiguousarray(flow))
+
+
+class SparseFlowAugmentor:
+    """Sparse-GT augmentation for KITTI/HD1K (augmentor.py:122-246)."""
+
+    def __init__(self, crop_size: Tuple[int, int], min_scale: float = -0.2,
+                 max_scale: float = 0.5, do_flip: bool = False,
+                 rng: Optional[np.random.RandomState] = None):
+        self.crop_size = crop_size
+        self.min_scale = min_scale
+        self.max_scale = max_scale
+        self.spatial_aug_prob = 0.8
+
+        self.do_flip = do_flip
+
+        self.photo_aug = ColorJitter(0.3, 0.3, 0.3, 0.3 / 3.14)
+        self.eraser_aug_prob = 0.5
+
+        self.rng = rng if rng is not None else np.random.RandomState()
+
+    def reseed(self, seed: int):
+        self.rng = np.random.RandomState(seed)
+
+    def color_transform(self, img1, img2):
+        # sparse path is always symmetric (augmentor.py:142-146)
+        stack = np.concatenate([img1, img2], axis=0)
+        stack = self.photo_aug(stack, self.rng)
+        img1, img2 = np.split(stack, 2, axis=0)
+        return img1, img2
+
+    def eraser_transform(self, img1, img2):
+        ht, wd = img1.shape[:2]
+        if self.rng.rand() < self.eraser_aug_prob:
+            mean_color = np.mean(img2.reshape(-1, 3), axis=0)
+            for _ in range(self.rng.randint(1, 3)):
+                x0 = self.rng.randint(0, wd)
+                y0 = self.rng.randint(0, ht)
+                dx = self.rng.randint(50, 100)
+                dy = self.rng.randint(50, 100)
+                img2[y0:y0 + dy, x0:x0 + dx, :] = mean_color
+        return img1, img2
+
+    def resize_sparse_flow_map(self, flow, valid, fx=1.0, fy=1.0):
+        """Nearest-point scatter rescale of sparse flow (augmentor.py:161-193)."""
+        ht, wd = flow.shape[:2]
+        coords = np.meshgrid(np.arange(wd), np.arange(ht))
+        coords = np.stack(coords, axis=-1).reshape(-1, 2).astype(np.float32)
+
+        flow = flow.reshape(-1, 2).astype(np.float32)
+        valid = valid.reshape(-1).astype(np.float32)
+
+        coords0 = coords[valid >= 1]
+        flow0 = flow[valid >= 1]
+
+        ht1 = int(round(ht * fy))
+        wd1 = int(round(wd * fx))
+
+        coords1 = coords0 * [fx, fy]
+        flow1 = flow0 * [fx, fy]
+
+        xx = np.round(coords1[:, 0]).astype(np.int32)
+        yy = np.round(coords1[:, 1]).astype(np.int32)
+
+        v = (xx > 0) & (xx < wd1) & (yy > 0) & (yy < ht1)
+        xx, yy, flow1 = xx[v], yy[v], flow1[v]
+
+        flow_img = np.zeros([ht1, wd1, 2], dtype=np.float32)
+        valid_img = np.zeros([ht1, wd1], dtype=np.int32)
+        flow_img[yy, xx] = flow1
+        valid_img[yy, xx] = 1
+        return flow_img, valid_img
+
+    def spatial_transform(self, img1, img2, flow, valid):
+        ht, wd = img1.shape[:2]
+        min_scale = np.maximum(
+            (self.crop_size[0] + 1) / float(ht),
+            (self.crop_size[1] + 1) / float(wd))
+
+        scale = 2 ** self.rng.uniform(self.min_scale, self.max_scale)
+        scale_x = np.clip(scale, min_scale, None)
+        scale_y = np.clip(scale, min_scale, None)
+
+        if self.rng.rand() < self.spatial_aug_prob:
+            img1 = cv2.resize(img1, None, fx=scale_x, fy=scale_y,
+                              interpolation=cv2.INTER_LINEAR)
+            img2 = cv2.resize(img2, None, fx=scale_x, fy=scale_y,
+                              interpolation=cv2.INTER_LINEAR)
+            flow, valid = self.resize_sparse_flow_map(flow, valid,
+                                                      fx=scale_x, fy=scale_y)
+
+        if self.do_flip:
+            if self.rng.rand() < 0.5:  # h-flip only (augmentor.py:213-218)
+                img1 = img1[:, ::-1]
+                img2 = img2[:, ::-1]
+                flow = flow[:, ::-1] * [-1.0, 1.0]
+                valid = valid[:, ::-1]
+
+        margin_y, margin_x = 20, 50
+        y0 = self.rng.randint(0, img1.shape[0] - self.crop_size[0] + margin_y)
+        x0 = self.rng.randint(-margin_x,
+                              img1.shape[1] - self.crop_size[1] + margin_x)
+        y0 = np.clip(y0, 0, img1.shape[0] - self.crop_size[0])
+        x0 = np.clip(x0, 0, img1.shape[1] - self.crop_size[1])
+
+        img1 = img1[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        img2 = img2[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        flow = flow[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        valid = valid[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        return img1, img2, flow, valid
+
+    def __call__(self, img1, img2, flow, valid):
+        img1, img2 = self.color_transform(img1, img2)
+        img1, img2 = self.eraser_transform(img1, img2)
+        img1, img2, flow, valid = self.spatial_transform(img1, img2, flow,
+                                                         valid)
+        return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),
+                np.ascontiguousarray(flow), np.ascontiguousarray(valid))

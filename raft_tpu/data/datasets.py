@@ -1,0 +1,299 @@
+"""Flow datasets: Sintel, FlyingChairs, FlyingThings3D, KITTI, HD1K.
+
+Equivalent of ``/root/reference/core/datasets.py`` as pure-Python indexers
+yielding **numpy NHWC** samples (no torch): img1/img2 (H, W, 3) float32,
+flow (H, W, 2) float32, valid (H, W) float32. Mixing uses the same
+list-replication trick (``__rmul__``, datasets.py:93-96) and the same stage
+recipes, e.g. sintel-stage mix 100·sc + 100·sf + 200·k + 5·h + things
+(datasets.py:218-221).
+
+FlyingChairs needs the upstream ``chairs_split.txt`` (1=train, 2=val). We do
+not bundle it; pass ``split_file`` or drop it in the dataset root
+(datasets.py:129 loads it from the working directory).
+"""
+
+from __future__ import annotations
+
+import os
+import os.path as osp
+from glob import glob
+from typing import Optional
+
+import numpy as np
+
+from raft_tpu.data import frame_utils
+from raft_tpu.data.augmentor import FlowAugmentor, SparseFlowAugmentor
+
+
+class FlowDataset:
+    def __init__(self, aug_params=None, sparse: bool = False):
+        self.augmentor = None
+        self.sparse = sparse
+        if aug_params is not None:
+            if sparse:
+                self.augmentor = SparseFlowAugmentor(**aug_params)
+            else:
+                self.augmentor = FlowAugmentor(**aug_params)
+
+        self.is_test = False
+        self.flow_list = []
+        self.image_list = []
+        self.extra_info = []
+
+    def reseed(self, seed: int):
+        if self.augmentor is not None:
+            self.augmentor.reseed(seed)
+
+    def __getitem__(self, index):
+        if self.is_test:
+            img1 = np.array(frame_utils.read_gen(self.image_list[index][0])
+                            ).astype(np.uint8)[..., :3]
+            img2 = np.array(frame_utils.read_gen(self.image_list[index][1])
+                            ).astype(np.uint8)[..., :3]
+            return (img1.astype(np.float32), img2.astype(np.float32),
+                    self.extra_info[index])
+
+        index = index % len(self.image_list)
+        valid = None
+        if self.sparse:
+            flow, valid = frame_utils.read_flow_kitti(self.flow_list[index])
+        else:
+            flow = frame_utils.read_gen(self.flow_list[index])
+
+        img1 = np.array(frame_utils.read_gen(self.image_list[index][0]))
+        img2 = np.array(frame_utils.read_gen(self.image_list[index][1]))
+        flow = np.array(flow).astype(np.float32)
+        img1 = img1.astype(np.uint8)
+        img2 = img2.astype(np.uint8)
+
+        if img1.ndim == 2:  # grayscale
+            img1 = np.tile(img1[..., None], (1, 1, 3))
+            img2 = np.tile(img2[..., None], (1, 1, 3))
+        else:
+            img1 = img1[..., :3]
+            img2 = img2[..., :3]
+
+        if self.augmentor is not None:
+            if self.sparse:
+                img1, img2, flow, valid = self.augmentor(img1, img2, flow,
+                                                         valid)
+            else:
+                img1, img2, flow = self.augmentor(img1, img2, flow)
+
+        img1 = img1.astype(np.float32)
+        img2 = img2.astype(np.float32)
+        flow = flow.astype(np.float32)
+
+        if valid is None:
+            # synthetic-data validity cutoff (datasets.py:88)
+            valid = ((np.abs(flow[..., 0]) < 1000)
+                     & (np.abs(flow[..., 1]) < 1000))
+        return img1, img2, flow, np.asarray(valid, np.float32)
+
+    def __rmul__(self, v: int):
+        self.flow_list = v * self.flow_list
+        self.image_list = v * self.image_list
+        return self
+
+    def __add__(self, other):
+        return ConcatDataset([self, other])
+
+    def __len__(self):
+        return len(self.image_list)
+
+
+class ConcatDataset:
+    """Minimal torch ConcatDataset analog for the mixing arithmetic."""
+
+    def __init__(self, datasets):
+        flat = []
+        for d in datasets:
+            if isinstance(d, ConcatDataset):
+                flat.extend(d.datasets)
+            else:
+                flat.append(d)
+        self.datasets = flat
+        self.cum = np.cumsum([len(d) for d in flat])
+
+    def reseed(self, seed: int):
+        for i, d in enumerate(self.datasets):
+            d.reseed(seed + i)
+
+    def __len__(self):
+        return int(self.cum[-1])
+
+    def __add__(self, other):
+        return ConcatDataset([self, other])
+
+    def __radd__(self, other):
+        return ConcatDataset([other, self])
+
+    def __getitem__(self, index):
+        ds = int(np.searchsorted(self.cum, index, side="right"))
+        prev = 0 if ds == 0 else int(self.cum[ds - 1])
+        return self.datasets[ds][index - prev]
+
+
+class MpiSintel(FlowDataset):
+    def __init__(self, aug_params=None, split="training",
+                 root="datasets/Sintel", dstype="clean"):
+        super().__init__(aug_params)
+        flow_root = osp.join(root, split, "flow")
+        image_root = osp.join(root, split, dstype)
+
+        if split == "test":
+            self.is_test = True
+
+        if osp.isdir(image_root):
+            for scene in sorted(os.listdir(image_root)):
+                image_list = sorted(glob(osp.join(image_root, scene, "*.png")))
+                for i in range(len(image_list) - 1):
+                    self.image_list += [[image_list[i], image_list[i + 1]]]
+                    self.extra_info += [(scene, i)]
+                if split != "test":
+                    self.flow_list += sorted(
+                        glob(osp.join(flow_root, scene, "*.flo")))
+
+
+class FlyingChairs(FlowDataset):
+    def __init__(self, aug_params=None, split="train",
+                 root="datasets/FlyingChairs_release/data",
+                 split_file: Optional[str] = None):
+        super().__init__(aug_params)
+
+        images = sorted(glob(osp.join(root, "*.ppm")))
+        flows = sorted(glob(osp.join(root, "*.flo")))
+        if not flows:
+            return
+        assert len(images) // 2 == len(flows)
+
+        if split_file is None:
+            for cand in ("chairs_split.txt",
+                         osp.join(root, "chairs_split.txt"),
+                         osp.join(root, "..", "chairs_split.txt")):
+                if osp.exists(cand):
+                    split_file = cand
+                    break
+        if split_file is None:
+            raise FileNotFoundError(
+                "chairs_split.txt not found; download from upstream RAFT and "
+                "pass split_file= or place it in the dataset root")
+        split_list = np.loadtxt(split_file, dtype=np.int32)
+        for i in range(len(flows)):
+            xid = split_list[i]
+            if (split == "training" and xid == 1) or \
+                    (split == "validation" and xid == 2):
+                self.flow_list += [flows[i]]
+                self.image_list += [[images[2 * i], images[2 * i + 1]]]
+
+
+class FlyingThings3D(FlowDataset):
+    def __init__(self, aug_params=None, root="datasets/FlyingThings3D",
+                 dstype="frames_cleanpass"):
+        super().__init__(aug_params)
+
+        for cam in ["left"]:
+            for direction in ["into_future", "into_past"]:
+                image_dirs = sorted(glob(osp.join(root, dstype, "TRAIN/*/*")))
+                image_dirs = sorted([osp.join(f, cam) for f in image_dirs])
+                flow_dirs = sorted(
+                    glob(osp.join(root, "optical_flow/TRAIN/*/*")))
+                flow_dirs = sorted(
+                    [osp.join(f, direction, cam) for f in flow_dirs])
+
+                for idir, fdir in zip(image_dirs, flow_dirs):
+                    images = sorted(glob(osp.join(idir, "*.png")))
+                    flows = sorted(glob(osp.join(fdir, "*.pfm")))
+                    for i in range(len(flows) - 1):
+                        if direction == "into_future":
+                            self.image_list += [[images[i], images[i + 1]]]
+                            self.flow_list += [flows[i]]
+                        else:
+                            self.image_list += [[images[i + 1], images[i]]]
+                            self.flow_list += [flows[i + 1]]
+
+
+class KITTI(FlowDataset):
+    def __init__(self, aug_params=None, split="training",
+                 root="datasets/KITTI"):
+        super().__init__(aug_params, sparse=True)
+        if split == "testing":
+            self.is_test = True
+
+        root = osp.join(root, split)
+        images1 = sorted(glob(osp.join(root, "image_2/*_10.png")))
+        images2 = sorted(glob(osp.join(root, "image_2/*_11.png")))
+
+        for img1, img2 in zip(images1, images2):
+            frame_id = img1.split("/")[-1]
+            self.extra_info += [[frame_id]]
+            self.image_list += [[img1, img2]]
+
+        if split == "training":
+            self.flow_list = sorted(glob(osp.join(root, "flow_occ/*_10.png")))
+
+
+class HD1K(FlowDataset):
+    def __init__(self, aug_params=None, root="datasets/HD1k"):
+        super().__init__(aug_params, sparse=True)
+
+        seq_ix = 0
+        while True:
+            flows = sorted(glob(osp.join(
+                root, "hd1k_flow_gt", "flow_occ/%06d_*.png" % seq_ix)))
+            images = sorted(glob(osp.join(
+                root, "hd1k_input", "image_2/%06d_*.png" % seq_ix)))
+            if len(flows) == 0:
+                break
+            for i in range(len(flows) - 1):
+                self.flow_list += [flows[i]]
+                self.image_list += [[images[i], images[i + 1]]]
+            seq_ix += 1
+
+
+def fetch_dataset(stage: str, image_size, data_root: str = "datasets",
+                  train_ds: str = "C+T+K+S+H"):
+    """Stage-keyed training dataset mix (datasets.py:199-228)."""
+    def p(name):
+        return osp.join(data_root, name)
+
+    if stage == "chairs":
+        aug = {"crop_size": image_size, "min_scale": -0.1, "max_scale": 1.0,
+               "do_flip": True}
+        return FlyingChairs(aug, split="training",
+                            root=p("FlyingChairs_release/data"))
+
+    if stage == "things":
+        aug = {"crop_size": image_size, "min_scale": -0.4, "max_scale": 0.8,
+               "do_flip": True}
+        clean = FlyingThings3D(aug, root=p("FlyingThings3D"),
+                               dstype="frames_cleanpass")
+        final = FlyingThings3D(aug, root=p("FlyingThings3D"),
+                               dstype="frames_finalpass")
+        return ConcatDataset([clean, final])
+
+    if stage == "sintel":
+        aug = {"crop_size": image_size, "min_scale": -0.2, "max_scale": 0.6,
+               "do_flip": True}
+        things = FlyingThings3D(aug, root=p("FlyingThings3D"),
+                                dstype="frames_cleanpass")
+        sintel_clean = MpiSintel(aug, split="training", root=p("Sintel"),
+                                 dstype="clean")
+        sintel_final = MpiSintel(aug, split="training", root=p("Sintel"),
+                                 dstype="final")
+        if train_ds == "C+T+K+S+H":
+            kitti = KITTI({"crop_size": image_size, "min_scale": -0.3,
+                           "max_scale": 0.5, "do_flip": True},
+                          root=p("KITTI"))
+            hd1k = HD1K({"crop_size": image_size, "min_scale": -0.5,
+                         "max_scale": 0.2, "do_flip": True}, root=p("HD1k"))
+            return ConcatDataset([100 * sintel_clean, 100 * sintel_final,
+                                  200 * kitti, 5 * hd1k, things])
+        return ConcatDataset([100 * sintel_clean, 100 * sintel_final, things])
+
+    if stage == "kitti":
+        aug = {"crop_size": image_size, "min_scale": -0.2, "max_scale": 0.4,
+               "do_flip": False}
+        return KITTI(aug, split="training", root=p("KITTI"))
+
+    raise ValueError(f"unknown stage {stage!r}")

@@ -1,0 +1,126 @@
+"""Threaded prefetching batch loader (the torch DataLoader replacement).
+
+The reference uses ``torch.utils.data.DataLoader(num_workers=4, shuffle=True,
+drop_last=True)`` (datasets.py:230-231). Here: a thread pool decodes and
+augments samples while the accelerator steps — cv2/PIL/numpy release the GIL
+for the heavy parts, and the optional C++ codec (raft_tpu.native) bypasses it
+entirely. Each worker thread gets its own reseeded RNG, mirroring the
+reference's per-worker reseeding (datasets.py:45-51).
+
+Batches are dicts of stacked numpy arrays, ready for ``jax.device_put``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+def _collate(samples) -> Dict[str, np.ndarray]:
+    img1, img2, flow, valid = zip(*samples)
+    return {
+        "image1": np.stack(img1),
+        "image2": np.stack(img2),
+        "flow": np.stack(flow),
+        "valid": np.stack(valid),
+    }
+
+
+class PrefetchLoader:
+    """Shuffled, batched, prefetching iterator over a FlowDataset."""
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = True,
+                 num_workers: int = 4, drop_last: bool = True,
+                 seed: int = 1234, prefetch: int = 4):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.num_workers = max(1, num_workers)
+        self.drop_last = drop_last
+        self.seed = seed
+        self.prefetch = prefetch
+        self.epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    def _epoch_indices(self) -> np.ndarray:
+        idx = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.RandomState(self.seed + self.epoch).shuffle(idx)
+        if self.drop_last:
+            idx = idx[:len(self) * self.batch_size]
+        return idx
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        indices = self._epoch_indices()
+        batches = [indices[i:i + self.batch_size]
+                   for i in range(0, len(indices), self.batch_size)]
+        self.epoch += 1
+
+        task_q: "queue.Queue" = queue.Queue()
+        results: Dict[int, object] = {}
+        cond = threading.Condition()
+        stop = threading.Event()
+        # bound how far workers run ahead of consumption
+        ahead = threading.Semaphore(self.prefetch + self.num_workers)
+
+        for bi, batch_idx in enumerate(batches):
+            task_q.put((bi, batch_idx))
+
+        def worker(worker_id: int):
+            # per-worker reseed (datasets.py:45-51 analog)
+            if hasattr(self.dataset, "reseed"):
+                self.dataset.reseed(self.seed + worker_id * 7919 + self.epoch)
+            while not stop.is_set():
+                ahead.acquire()
+                try:
+                    bi, batch_idx = task_q.get_nowait()
+                except queue.Empty:
+                    ahead.release()
+                    return
+                try:
+                    batch = _collate([self.dataset[int(i)]
+                                      for i in batch_idx])
+                except Exception as e:  # surface decode errors to consumer
+                    batch = e
+                with cond:
+                    results[bi] = batch
+                    cond.notify_all()
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+
+        try:
+            for next_bi in range(len(batches)):
+                with cond:
+                    cond.wait_for(lambda: next_bi in results)
+                    batch = results.pop(next_bi)
+                ahead.release()
+                if isinstance(batch, Exception):
+                    raise batch
+                yield batch
+        finally:
+            stop.set()
+            with cond:
+                results.clear()
+
+
+def fetch_dataloader(stage: str, image_size, batch_size: int,
+                     data_root: str = "datasets", num_workers: int = 4,
+                     seed: int = 1234) -> PrefetchLoader:
+    """Stage-preset loader, the fetch_dataloader analog (datasets.py:199)."""
+    from raft_tpu.data.datasets import fetch_dataset
+
+    dataset = fetch_dataset(stage, image_size, data_root)
+    print(f"Training with {len(dataset)} image pairs")
+    return PrefetchLoader(dataset, batch_size, shuffle=True,
+                          num_workers=num_workers, drop_last=True, seed=seed)

@@ -1,0 +1,7 @@
+from raft_tpu.evaluation.evaluate import (  # noqa: F401
+    create_kitti_submission,
+    create_sintel_submission,
+    validate_chairs,
+    validate_kitti,
+    validate_sintel,
+)

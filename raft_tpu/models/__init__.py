@@ -1,0 +1,23 @@
+from raft_tpu.models.corr import (  # noqa: F401
+    AlternateCorrBlock,
+    CorrBlock,
+    alt_corr_lookup,
+    build_corr_pyramid,
+    corr_lookup,
+)
+from raft_tpu.models.encoders import BasicEncoder, SmallEncoder  # noqa: F401
+from raft_tpu.models.layers import (  # noqa: F401
+    BottleneckBlock,
+    Norm,
+    ResidualBlock,
+    TorchConv,
+    instance_norm,
+)
+from raft_tpu.models.raft import RAFT, create_raft  # noqa: F401
+from raft_tpu.models.update import (  # noqa: F401
+    BasicUpdateBlock,
+    ConvGRU,
+    FlowHead,
+    SepConvGRU,
+    SmallUpdateBlock,
+)

@@ -1,0 +1,244 @@
+"""Correlation volume: all-pairs pyramid + windowed lookup (NHWC, TPU-first).
+
+Replaces ``core/corr.py`` and the CUDA ``alt_cuda_corr`` extension
+(``alt_cuda_corr/correlation_kernel.cu``). Two paths, same output layout:
+
+- ``CorrBlock``: materialize the (H1·W1)×(H2·W2) volume with ONE big MXU
+  GEMM (``corr.py:52-60``), average-pool a 4-level pyramid (``corr.py:25-27``),
+  and per iteration gather a (2r+1)² window around the current coords
+  (``corr.py:29-50``) via flattened-index 4-corner gathers.
+- ``AlternateCorrBlock``: never materialize the volume; per iteration
+  bilinearly sample fmap2 at the window points and dot with fmap1
+  (O(HW·(2r+1)²·levels) memory). Since correlation is linear in fmap2,
+  interpolate-then-dot ≡ sampling the true corr volume — exactly what the
+  CUDA kernel computes with its scatter form (correlation_kernel.cu:19-119).
+
+Output channel layout (the checkpoint parity surface): c = level·K² +
+x_idx·K + y_idx with K = 2r+1 — the x-offset enumerates the OUTER index.
+This mirrors both reference paths: ``corr.py:39-43`` adds the meshgrid's
+``dy`` to the x coordinate, and the CUDA kernel scatters to channel
+``(iy-1) + rd*(ix-1)`` (correlation_kernel.cu:92-95) — i.e. x-major.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops.pooling import avg_pool2x2
+
+HIGHEST = jax.lax.Precision.HIGHEST
+
+
+def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
+    """(B,H,W,C) x2 -> (B, H*W, H, W) all-pairs dot products / sqrt(C).
+
+    Equivalent of ``CorrBlock.corr`` (corr.py:52-60). fp32 island: the
+    reference casts fmaps to fp32 before correlation regardless of autocast
+    (core/raft.py:102-103); precision=HIGHEST keeps the MXU in fp32-accurate
+    mode for it.
+    """
+    B, H, W, C = fmap1.shape
+    f1 = fmap1.astype(jnp.float32).reshape(B, H * W, C)
+    f2 = fmap2.astype(jnp.float32).reshape(B, H * W, C)
+    corr = jnp.einsum("bxc,byc->bxy", f1, f2, precision=HIGHEST)
+    corr = corr / math.sqrt(C)
+    return corr.reshape(B, H * W, H, W)
+
+
+def build_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array,
+                       num_levels: int = 4) -> List[jax.Array]:
+    """List of (B, N, Hl, Wl) volumes, level 0 full res (corr.py:18-27)."""
+    corr = all_pairs_correlation(fmap1, fmap2)
+    pyramid = [corr]
+    for _ in range(num_levels - 1):
+        c = avg_pool2x2(corr[..., None])[..., 0]
+        pyramid.append(c)
+        corr = c
+    return pyramid
+
+
+def _window_offsets(radius: int):
+    """(K², ) x/y offsets, x-major channel order (see module docstring)."""
+    d = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    K = 2 * radius + 1
+    du = jnp.repeat(d, K)   # x offset: outer index
+    dv = jnp.tile(d, K)     # y offset: inner index
+    return du, dv
+
+
+def _gather_bilinear_2d(vol: jax.Array, xs: jax.Array, ys: jax.Array) -> jax.Array:
+    """Bilinear-sample ``vol`` (B, N, H, W) at per-(B,N) points (B, N, P).
+
+    zeros out-of-bounds (grid_sample padding_mode='zeros' semantics).
+    Returns (B, N, P). Implemented as 4 flattened-index gathers so XLA emits
+    batched dynamic-gathers instead of scatter/gather soup.
+    """
+    B, N, H, W = vol.shape
+    flat = vol.reshape(B, N, H * W)
+
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+    wx = xs - x0
+    wy = ys - y0
+
+    def corner(xi, yi, w):
+        valid = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xi_c = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yi_c = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        idx = yi_c * W + xi_c
+        vals = jnp.take_along_axis(flat, idx, axis=2)
+        return vals * (w * valid.astype(jnp.float32))
+
+    return (
+        corner(x0, y0, (1 - wx) * (1 - wy))
+        + corner(x0 + 1, y0, wx * (1 - wy))
+        + corner(x0, y0 + 1, (1 - wx) * wy)
+        + corner(x0 + 1, y0 + 1, wx * wy)
+    )
+
+
+def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
+                radius: int) -> jax.Array:
+    """Sample (2r+1)² windows at every level around ``coords`` (B,H,W,2).
+
+    Returns (B, H, W, num_levels*K²) fp32 — the per-iteration correlation
+    features (corr.py:29-50).
+    """
+    B, H, W, _ = coords.shape
+    N = H * W
+    du, dv = _window_offsets(radius)
+
+    x = coords[..., 0].reshape(B, N, 1).astype(jnp.float32)
+    y = coords[..., 1].reshape(B, N, 1).astype(jnp.float32)
+
+    out = []
+    for i, vol in enumerate(pyramid):
+        xs = x / (2 ** i) + du[None, None, :]
+        ys = y / (2 ** i) + dv[None, None, :]
+        out.append(_gather_bilinear_2d(vol, xs, ys))
+    return jnp.concatenate(out, axis=-1).reshape(B, H, W, -1)
+
+
+class CorrBlock:
+    """Materialized-pyramid path (corr.py:12-60)."""
+
+    def __init__(self, fmap1: jax.Array, fmap2: jax.Array,
+                 num_levels: int = 4, radius: int = 4):
+        self.num_levels = num_levels
+        self.radius = radius
+        self.pyramid = build_corr_pyramid(fmap1, fmap2, num_levels)
+
+    def __call__(self, coords: jax.Array) -> jax.Array:
+        return corr_lookup(self.pyramid, coords, self.radius)
+
+
+# ---------------------------------------------------------------------------
+# Memory-efficient path (alt_cuda_corr equivalent)
+# ---------------------------------------------------------------------------
+
+
+def _gather_bilinear_fmap(fmap: jax.Array, xs: jax.Array, ys: jax.Array
+                          ) -> jax.Array:
+    """Bilinear-sample ``fmap`` (B, H, W, C) at (B, N, P) points -> (B,N,P,C)."""
+    B, H, W, C = fmap.shape
+    flat = fmap.reshape(B, H * W, C)
+
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+    wx = xs - x0
+    wy = ys - y0
+
+    def corner(xi, yi, w):
+        valid = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xi_c = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yi_c = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        idx = (yi_c * W + xi_c).reshape(B, -1)           # (B, N*P)
+        vals = jnp.take_along_axis(flat, idx[..., None], axis=1)
+        vals = vals.reshape(*xi.shape, C)                 # (B, N, P, C)
+        w = (w * valid.astype(jnp.float32))[..., None]
+        return vals * w
+
+    return (
+        corner(x0, y0, (1 - wx) * (1 - wy))
+        + corner(x0 + 1, y0, wx * (1 - wy))
+        + corner(x0, y0 + 1, (1 - wx) * wy)
+        + corner(x0 + 1, y0 + 1, wx * wy)
+    )
+
+
+def alt_corr_lookup(fmap1: jax.Array, fmap2_pyramid: Sequence[jax.Array],
+                    coords: jax.Array, radius: int,
+                    chunk: int = 4096) -> jax.Array:
+    """On-the-fly windowed correlation, never materializing (HW)².
+
+    For each level: sample fmap2 at the window points, dot with fmap1.
+    Chunked over query pixels to bound the (chunk, K², C) intermediate —
+    the VMEM-sized tiling a Pallas kernel would use, expressed at the XLA
+    level. Matches ``AlternateCorrBlock`` (corr.py:63-91) which normalizes
+    once by sqrt(dim of level-0 fmap).
+    """
+    B, H, W, C = fmap1.shape
+    N = H * W
+    du, dv = _window_offsets(radius)
+    K2 = du.shape[0]
+
+    f1 = fmap1.astype(jnp.float32).reshape(B, N, C)
+    x = coords[..., 0].reshape(B, N).astype(jnp.float32)
+    y = coords[..., 1].reshape(B, N).astype(jnp.float32)
+
+    n_chunks = max(1, -(-N // chunk))
+    pad = n_chunks * chunk - N
+    if pad:
+        f1 = jnp.pad(f1, ((0, 0), (0, pad), (0, 0)))
+        x = jnp.pad(x, ((0, 0), (0, pad)))
+        y = jnp.pad(y, ((0, 0), (0, pad)))
+
+    f1 = f1.reshape(B, n_chunks, chunk, C).transpose(1, 0, 2, 3)
+    x = x.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+    y = y.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+
+    def process_chunk(args):
+        f1_c, x_c, y_c = args  # (B, chunk, C), (B, chunk)
+        outs = []
+        for i, f2 in enumerate(fmap2_pyramid):
+            xs = x_c[..., None] / (2 ** i) + du[None, None, :]
+            ys = y_c[..., None] / (2 ** i) + dv[None, None, :]
+            f2v = _gather_bilinear_fmap(f2.astype(jnp.float32), xs, ys)
+            corr = jnp.einsum("bnkc,bnc->bnk", f2v, f1_c, precision=HIGHEST)
+            outs.append(corr)
+        return jnp.concatenate(outs, axis=-1)  # (B, chunk, L*K²)
+
+    out = jax.lax.map(process_chunk, (f1, x, y))  # (n_chunks, B, chunk, LK²)
+    out = out.transpose(1, 0, 2, 3).reshape(B, n_chunks * chunk, -1)
+    if pad:
+        out = out[:, :N]
+    return (out / math.sqrt(C)).reshape(B, H, W, -1)
+
+
+class AlternateCorrBlock:
+    """Memory-efficient path (corr.py:63-91 + alt_cuda_corr).
+
+    Builds the pooled fmap2 pyramid once; per call recomputes windowed
+    correlation. Note the reference builds num_levels+1 pyramid entries but
+    only indexes 0..num_levels-1 and always level-0 fmap1
+    (corr.py:68-72,82-83) — we build only what is used.
+    """
+
+    def __init__(self, fmap1: jax.Array, fmap2: jax.Array,
+                 num_levels: int = 4, radius: int = 4, chunk: int = 4096):
+        self.radius = radius
+        self.chunk = chunk
+        self.fmap1 = fmap1
+        self.fmap2_pyramid = [fmap2]
+        f2 = fmap2
+        for _ in range(num_levels - 1):
+            f2 = avg_pool2x2(f2)
+            self.fmap2_pyramid.append(f2)
+
+    def __call__(self, coords: jax.Array) -> jax.Array:
+        return alt_corr_lookup(self.fmap1, self.fmap2_pyramid, coords,
+                               self.radius, self.chunk)

@@ -1,0 +1,81 @@
+"""Feature/context encoders (extractor.py:118-267), NHWC flax.
+
+3-stage residual CNNs with total stride 8. The two input images are batched
+through one conv pass (``extractor.py:171-174``) by the caller concatenating
+on the batch dim — on TPU this doubles the effective GEMM batch for the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from raft_tpu.models.layers import (
+    BottleneckBlock,
+    Norm,
+    ResidualBlock,
+    TorchConv,
+    conv1x1,
+)
+
+
+class BasicEncoder(nn.Module):
+    """64 -> 64 -> 96 -> 128 residual encoder + 1x1 head (extractor.py:118)."""
+
+    output_dim: int = 128
+    norm_fn: str = "batch"
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, use_running_average: bool = True):
+        ura = use_running_average
+        x = TorchConv(64, (7, 7), (2, 2), (3, 3), self.dtype, name="conv1")(x)
+        # stem GroupNorm uses 8 groups, not 64//8 (extractor.py:124)
+        x = Norm(self.norm_fn, 64, num_groups=8, name="norm1")(x, ura)
+        x = nn.relu(x)
+
+        for i, (dim, stride) in enumerate([(64, 1), (96, 2), (128, 2)], 1):
+            x = ResidualBlock(dim, self.norm_fn, stride, self.dtype,
+                              name=f"layer{i}_0")(x, ura)
+            x = ResidualBlock(dim, self.norm_fn, 1, self.dtype,
+                              name=f"layer{i}_1")(x, ura)
+
+        x = conv1x1(self.output_dim, 1, self.dtype, name="conv2")(x)
+
+        if self.dropout > 0:
+            # torch Dropout2d drops whole channels (extractor.py:146-148)
+            x = nn.Dropout(self.dropout, broadcast_dims=(1, 2),
+                           deterministic=not train)(x)
+        return x
+
+
+class SmallEncoder(nn.Module):
+    """32 -> 32 -> 64 -> 96 bottleneck encoder + 1x1 head (extractor.py:195)."""
+
+    output_dim: int = 128
+    norm_fn: str = "batch"
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, use_running_average: bool = True):
+        ura = use_running_average
+        x = TorchConv(32, (7, 7), (2, 2), (3, 3), self.dtype, name="conv1")(x)
+        x = Norm(self.norm_fn, 32, num_groups=8, name="norm1")(x, ura)
+        x = nn.relu(x)
+
+        for i, (dim, stride) in enumerate([(32, 1), (64, 2), (96, 2)], 1):
+            x = BottleneckBlock(dim, self.norm_fn, stride, self.dtype,
+                                name=f"layer{i}_0")(x, ura)
+            x = BottleneckBlock(dim, self.norm_fn, 1, self.dtype,
+                                name=f"layer{i}_1")(x, ura)
+
+        x = conv1x1(self.output_dim, 1, self.dtype, name="conv2")(x)
+
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, broadcast_dims=(1, 2),
+                           deterministic=not train)(x)
+        return x

@@ -1,0 +1,189 @@
+"""Building-block layers: conv init, norms, residual blocks (NHWC, flax).
+
+Equivalents of ``/root/reference/core/extractor.py:6-116`` with the four norm
+variants. Parameter layouts are flax-native (HWIO kernels, channels-last);
+the checkpoint converter handles the OIHW transpose.
+
+Padding note: torch ``Conv2d(padding=p)`` pads symmetrically by p. XLA
+``'SAME'`` pads asymmetrically for strided convs (low side gets less), which
+shifts windows by one pixel on even sizes — so every conv here uses explicit
+torch-style symmetric padding.
+
+Norm parity notes (torch defaults the reference relies on):
+- ``nn.InstanceNorm2d(planes)`` has ``affine=False, track_running_stats=False``
+  -> parameter-free, always per-sample stats. Stateless function here.
+- ``nn.BatchNorm2d``: torch momentum 0.1 == flax momentum 0.9; eps 1e-5.
+- ``nn.GroupNorm``: affine, eps 1e-5, ``num_groups = planes // 8``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Dtype = Any
+
+# Kaiming-normal fan_out/relu, matching extractor.py:150-157.
+kaiming_normal = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+def torch_bias_init(fan_in: int) -> Callable:
+    """torch Conv2d default bias init: U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+
+    def init(key, shape, dtype=jnp.float32):
+        bound = 1.0 / np.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+class TorchConv(nn.Module):
+    """NHWC conv matching torch ``Conv2d(k, stride, padding)`` semantics.
+
+    ``padding`` is torch-style: symmetric (ph, pw) pixels. Params stored
+    fp32; compute in ``dtype`` (the mixed-precision autocast analog).
+    """
+
+    features: int
+    kernel_size: tuple
+    strides: tuple = (1, 1)
+    padding: tuple = (0, 0)
+    dtype: Dtype = jnp.float32
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        ph, pw = self.padding
+        in_feat = x.shape[-1]
+        kernel = self.param(
+            "kernel", kaiming_normal, (kh, kw, in_feat, self.features),
+            jnp.float32,
+        )
+        y = jax.lax.conv_general_dilated(
+            x.astype(self.dtype),
+            kernel.astype(self.dtype),
+            window_strides=self.strides,
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias", torch_bias_init(in_feat * kh * kw), (self.features,),
+                jnp.float32,
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+def conv3x3(features, stride=1, dtype=jnp.float32, name=None):
+    return TorchConv(features, (3, 3), (stride, stride), (1, 1), dtype,
+                     name=name)
+
+
+def conv1x1(features, stride=1, dtype=jnp.float32, name=None):
+    return TorchConv(features, (1, 1), (stride, stride), (0, 0), dtype,
+                     name=name)
+
+
+def instance_norm(x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """Parameter-free instance norm over H, W (torch InstanceNorm2d defaults)."""
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=(1, 2), keepdims=True)
+    var = x32.var(axis=(1, 2), keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return out.astype(x.dtype)
+
+
+class Norm(nn.Module):
+    """Dispatch over the reference's 4 norm options (extractor.py:16-38).
+
+    ``use_running_average`` only affects 'batch'; passing True implements both
+    eval mode and ``freeze_bn`` (core/raft.py:58-61). Norms compute in fp32
+    (torch autocast always runs norms fp32).
+    """
+
+    norm_fn: str  # 'group' | 'batch' | 'instance' | 'none'
+    features: int
+    num_groups: Optional[int] = None  # default features // 8 as reference
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = True):
+        if self.norm_fn == "group":
+            groups = self.num_groups if self.num_groups else self.features // 8
+            return nn.GroupNorm(num_groups=groups, epsilon=1e-5,
+                                dtype=jnp.float32, name="norm")(x)
+        if self.norm_fn == "batch":
+            return nn.BatchNorm(
+                use_running_average=use_running_average,
+                momentum=0.9, epsilon=1e-5, dtype=jnp.float32, name="norm",
+            )(x)
+        if self.norm_fn == "instance":
+            return instance_norm(x)
+        return x  # 'none'
+
+
+class ResidualBlock(nn.Module):
+    """Two 3x3 convs + skip (extractor.py:6-56)."""
+
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = True):
+        y = conv3x3(self.planes, self.stride, self.dtype, name="conv1")(x)
+        y = Norm(self.norm_fn, self.planes, name="norm1")(y, use_running_average)
+        y = nn.relu(y)
+        y = conv3x3(self.planes, 1, self.dtype, name="conv2")(y)
+        y = Norm(self.norm_fn, self.planes, name="norm2")(y, use_running_average)
+        y = nn.relu(y)
+
+        if self.stride != 1:
+            x = conv1x1(self.planes, self.stride, self.dtype,
+                        name="downsample_conv")(x)
+            x = Norm(self.norm_fn, self.planes, name="norm3")(
+                x, use_running_average)
+
+        return nn.relu(x + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck + skip (extractor.py:60-116)."""
+
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = True):
+        p4 = self.planes // 4
+        # reference num_groups = planes//8 for ALL norms in the block,
+        # including the planes//4-channel ones (extractor.py:69-74).
+        g = self.planes // 8
+        y = conv1x1(p4, 1, self.dtype, name="conv1")(x)
+        y = Norm(self.norm_fn, p4, num_groups=g, name="norm1")(
+            y, use_running_average)
+        y = nn.relu(y)
+        y = conv3x3(p4, self.stride, self.dtype, name="conv2")(y)
+        y = Norm(self.norm_fn, p4, num_groups=g, name="norm2")(
+            y, use_running_average)
+        y = nn.relu(y)
+        y = conv1x1(self.planes, 1, self.dtype, name="conv3")(y)
+        y = Norm(self.norm_fn, self.planes, num_groups=g, name="norm3")(
+            y, use_running_average)
+        y = nn.relu(y)
+
+        if self.stride != 1:
+            x = conv1x1(self.planes, self.stride, self.dtype,
+                        name="downsample_conv")(x)
+            x = Norm(self.norm_fn, self.planes, num_groups=g, name="norm4")(
+                x, use_running_average)
+
+        return nn.relu(x + y)

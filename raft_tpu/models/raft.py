@@ -1,0 +1,148 @@
+"""RAFT model: encoders + correlation + scanned recurrent refinement.
+
+TPU-native re-design of ``/root/reference/core/raft.py``. Differences from
+the reference that are deliberate:
+
+- The refinement loop is a ``flax.linen.scan`` (= ``lax.scan``) over the
+  update block — one compiled iteration body instead of an unrolled graph,
+  with ``stop_gradient`` on the coordinate chain replicating the
+  per-iteration ``coords1.detach()`` autograd structure (core/raft.py:123).
+- NHWC layout; both images run through the feature net as one doubled batch
+  (core/extractor.py:171-174) to keep MXU GEMMs large.
+- ``test_mode`` returns BOTH the low-res flow and the upsampled flow,
+  restoring upstream semantics (the fork's single-output return at
+  core/raft.py:141-143 breaks its own eval callers — see SURVEY.md).
+- fp32 islands under mixed precision: fmaps are cast to fp32 before
+  correlation (core/raft.py:102-103); lookups and convex upsampling run fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models.corr import (
+    AlternateCorrBlock,
+    CorrBlock,
+    alt_corr_lookup,
+    build_corr_pyramid,
+    corr_lookup,
+)
+from raft_tpu.models.encoders import BasicEncoder, SmallEncoder
+from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
+from raft_tpu.ops.flow_ops import convex_upsample, initialize_flow, upflow8
+from raft_tpu.ops.pooling import avg_pool2x2
+
+
+class RAFT(nn.Module):
+    """Recurrent All-Pairs Field Transforms (core/raft.py:24)."""
+
+    config: RAFTConfig = RAFTConfig()
+
+    def setup(self):
+        cfg = self.config
+        dt = cfg.compute_dtype
+        if cfg.small:
+            self.fnet = SmallEncoder(cfg.fnet_dim, cfg.fnet_norm, cfg.dropout,
+                                     dt)
+            self.cnet = SmallEncoder(cfg.cnet_dim, cfg.cnet_norm, cfg.dropout,
+                                     dt)
+            self.update_block = SmallUpdateBlock(cfg.hidden_dim, dt)
+        else:
+            self.fnet = BasicEncoder(cfg.fnet_dim, cfg.fnet_norm, cfg.dropout,
+                                     dt)
+            self.cnet = BasicEncoder(cfg.cnet_dim, cfg.cnet_norm, cfg.dropout,
+                                     dt)
+            self.update_block = BasicUpdateBlock(cfg.hidden_dim, dt)
+
+    def __call__(self, image1, image2, iters: int = 12,
+                 flow_init: Optional[jax.Array] = None,
+                 test_mode: bool = False, train: bool = False,
+                 freeze_bn: bool = False):
+        """Estimate flow. Images: (B, H, W, 3) float in [0, 255], H, W % 8 == 0.
+
+        Returns all per-iteration upsampled flows (iters, B, H, W, 2) in
+        train mode, or ``(flow_low, flow_up)`` in test mode.
+        """
+        cfg = self.config
+        dt = cfg.compute_dtype
+        B, H, W, _ = image1.shape
+        assert H % 8 == 0 and W % 8 == 0, "pad inputs with InputPadder first"
+        ura = (not train) or freeze_bn  # BatchNorm running-average switch
+
+        # normalize to [-1, 1] (core/raft.py:89-90)
+        image1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
+        image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
+
+        # feature network over both images as one batch
+        fmaps = self.fnet(jnp.concatenate([image1, image2], axis=0),
+                          train=train, use_running_average=ura)
+        fmap1 = fmaps[:B].astype(jnp.float32)   # fp32 island for correlation
+        fmap2 = fmaps[B:].astype(jnp.float32)
+
+        if cfg.alternate_corr:
+            pyr = [fmap2]
+            f2 = fmap2
+            for _ in range(cfg.corr_levels - 1):
+                f2 = avg_pool2x2(f2)
+                pyr.append(f2)
+            corr_state = (fmap1, tuple(pyr))
+
+            def lookup(state, coords):
+                f1, f2_pyr = state
+                return alt_corr_lookup(f1, f2_pyr, coords, cfg.corr_radius)
+        else:
+            corr_state = tuple(
+                build_corr_pyramid(fmap1, fmap2, cfg.corr_levels))
+
+            def lookup(state, coords):
+                return corr_lookup(state, coords, cfg.corr_radius)
+
+        # context network (core/raft.py:110-114)
+        cnet = self.cnet(image1, train=train, use_running_average=ura)
+        net = jnp.tanh(cnet[..., :cfg.hidden_dim]).astype(dt)
+        inp = nn.relu(cnet[..., cfg.hidden_dim:]).astype(dt)
+
+        coords0, coords1 = initialize_flow(B, H // 8, W // 8)
+        if flow_init is not None:
+            coords1 = coords1 + flow_init
+
+        small = cfg.small
+
+        def _iteration(update_block, carry, inp, coords0, corr_state):
+            net, coords1 = carry
+            coords1 = jax.lax.stop_gradient(coords1)  # core/raft.py:123
+            corr = lookup(corr_state, coords1)
+            flow = coords1 - coords0
+            net, up_mask, delta = update_block(
+                net, inp, corr.astype(dt), flow.astype(dt))
+            coords1 = coords1 + delta.astype(jnp.float32)
+            new_flow = coords1 - coords0
+            if small:
+                flow_up = upflow8(new_flow)
+            else:
+                flow_up = convex_upsample(new_flow, up_mask)
+            return (net, coords1), flow_up
+
+        scan = nn.scan(
+            _iteration,
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": False},
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            out_axes=0,
+            length=iters,
+        )
+        (net, coords1), flow_predictions = scan(
+            self.update_block, (net, coords1), inp, coords0, corr_state)
+
+        if test_mode:
+            return coords1 - coords0, flow_predictions[-1]
+        return flow_predictions
+
+
+def create_raft(config: RAFTConfig = RAFTConfig()):
+    return RAFT(config)

@@ -1,0 +1,156 @@
+"""Recurrent update blocks: motion encoders, ConvGRUs, flow/mask heads.
+
+Equivalents of ``/root/reference/core/update.py`` (NHWC, flax). Channel
+arithmetic is the parity surface: basic corr feature 4·(2·4+1)²=324, motion
+feature 126+2=128, GRU input 128+128 (update.py:82,87,97,119); small corr
+feature 4·49=196, motion 80+2=82, GRU input 82+64 (update.py:65,103).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from raft_tpu.models.layers import TorchConv
+
+
+class FlowHead(nn.Module):
+    """2-layer conv head -> delta flow (update.py:6-14)."""
+
+    hidden_dim: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = TorchConv(self.hidden_dim, (3, 3), (1, 1), (1, 1), self.dtype,
+                      name="conv1")(x)
+        x = nn.relu(x)
+        return TorchConv(2, (3, 3), (1, 1), (1, 1), self.dtype,
+                         name="conv2")(x)
+
+
+class ConvGRU(nn.Module):
+    """Full 3x3 ConvGRU (update.py:16-31). h, x: NHWC."""
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, x):
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(TorchConv(self.hidden_dim, (3, 3), (1, 1), (1, 1),
+                                 self.dtype, name="convz")(hx))
+        r = nn.sigmoid(TorchConv(self.hidden_dim, (3, 3), (1, 1), (1, 1),
+                                 self.dtype, name="convr")(hx))
+        q = nn.tanh(TorchConv(self.hidden_dim, (3, 3), (1, 1), (1, 1),
+                              self.dtype, name="convq")(
+            jnp.concatenate([r * h, x], axis=-1)))
+        return (1 - z) * h + z * q
+
+
+class SepConvGRU(nn.Module):
+    """Separable 1x5 + 5x1 ConvGRU (update.py:33-60)."""
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, x):
+        # horizontal (1x5)
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(TorchConv(self.hidden_dim, (1, 5), (1, 1), (0, 2),
+                                 self.dtype, name="convz1")(hx))
+        r = nn.sigmoid(TorchConv(self.hidden_dim, (1, 5), (1, 1), (0, 2),
+                                 self.dtype, name="convr1")(hx))
+        q = nn.tanh(TorchConv(self.hidden_dim, (1, 5), (1, 1), (0, 2),
+                              self.dtype, name="convq1")(
+            jnp.concatenate([r * h, x], axis=-1)))
+        h = (1 - z) * h + z * q
+
+        # vertical (5x1)
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(TorchConv(self.hidden_dim, (5, 1), (1, 1), (2, 0),
+                                 self.dtype, name="convz2")(hx))
+        r = nn.sigmoid(TorchConv(self.hidden_dim, (5, 1), (1, 1), (2, 0),
+                                 self.dtype, name="convr2")(hx))
+        q = nn.tanh(TorchConv(self.hidden_dim, (5, 1), (1, 1), (2, 0),
+                              self.dtype, name="convq2")(
+            jnp.concatenate([r * h, x], axis=-1)))
+        return (1 - z) * h + z * q
+
+
+class SmallMotionEncoder(nn.Module):
+    """corr+flow -> 80+2 ch motion features (update.py:62-77)."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        cor = nn.relu(TorchConv(96, (1, 1), (1, 1), (0, 0), self.dtype,
+                                name="convc1")(corr))
+        flo = nn.relu(TorchConv(64, (7, 7), (1, 1), (3, 3), self.dtype,
+                                name="convf1")(flow))
+        flo = nn.relu(TorchConv(32, (3, 3), (1, 1), (1, 1), self.dtype,
+                                name="convf2")(flo))
+        out = nn.relu(TorchConv(80, (3, 3), (1, 1), (1, 1), self.dtype,
+                                name="conv")(jnp.concatenate([cor, flo], -1)))
+        return jnp.concatenate([out, flow.astype(out.dtype)], axis=-1)
+
+
+class BasicMotionEncoder(nn.Module):
+    """corr+flow -> 126+2 ch motion features (update.py:79-97)."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        cor = nn.relu(TorchConv(256, (1, 1), (1, 1), (0, 0), self.dtype,
+                                name="convc1")(corr))
+        cor = nn.relu(TorchConv(192, (3, 3), (1, 1), (1, 1), self.dtype,
+                                name="convc2")(cor))
+        flo = nn.relu(TorchConv(128, (7, 7), (1, 1), (3, 3), self.dtype,
+                                name="convf1")(flow))
+        flo = nn.relu(TorchConv(64, (3, 3), (1, 1), (1, 1), self.dtype,
+                                name="convf2")(flo))
+        out = nn.relu(TorchConv(126, (3, 3), (1, 1), (1, 1), self.dtype,
+                                name="conv")(jnp.concatenate([cor, flo], -1)))
+        return jnp.concatenate([out, flow.astype(out.dtype)], axis=-1)
+
+
+class SmallUpdateBlock(nn.Module):
+    """Motion encoder + ConvGRU + flow head; no mask head (update.py:99-112)."""
+
+    hidden_dim: int = 96
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, net, inp, corr, flow):
+        motion = SmallMotionEncoder(self.dtype, name="encoder")(flow, corr)
+        gru_in = jnp.concatenate([inp, motion], axis=-1)
+        net = ConvGRU(self.hidden_dim, self.dtype, name="gru")(net, gru_in)
+        delta = FlowHead(128, self.dtype, name="flow_head")(net)
+        return net, None, delta
+
+
+class BasicUpdateBlock(nn.Module):
+    """Motion encoder + SepConvGRU + flow head + mask head (update.py:114-136)."""
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, net, inp, corr, flow):
+        motion = BasicMotionEncoder(self.dtype, name="encoder")(flow, corr)
+        gru_in = jnp.concatenate([inp, motion], axis=-1)
+        net = SepConvGRU(self.hidden_dim, self.dtype, name="gru")(net, gru_in)
+        delta = FlowHead(256, self.dtype, name="flow_head")(net)
+
+        # .25 scale to balance gradients (update.py:134-135)
+        mask = TorchConv(256, (3, 3), (1, 1), (1, 1), self.dtype,
+                         name="mask_conv1")(net)
+        mask = nn.relu(mask)
+        mask = TorchConv(64 * 9, (1, 1), (1, 1), (0, 0), self.dtype,
+                         name="mask_conv2")(mask)
+        return net, 0.25 * mask, delta

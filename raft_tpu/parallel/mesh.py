@@ -1,0 +1,67 @@
+"""Device mesh + sharding layout for SPMD training (TPU-first).
+
+The reference's only parallelism is single-process ``nn.DataParallel``
+(train.py:138) — batch scatter over CUDA peers. The TPU-native replacement:
+a ``jax.sharding.Mesh`` with two axes:
+
+- ``data``: batch-dim sharding (the DataParallel analog). Gradient reduction
+  is inserted by XLA SPMD as ``psum`` over ICI — no NCCL, no process groups.
+- ``spatial``: image-height sharding — the 2D analog of sequence/context
+  parallelism. Convs get halo exchanges, the all-pairs correlation shards
+  its query dimension (each chip owns its rows of the (HW)² volume) and
+  XLA all-gathers fmap2 keys — the blockwise/ring-attention layout for
+  resolutions that exceed one chip's HBM (SURVEY.md §5 long-context).
+
+Multi-host: ``jax.distributed.initialize`` + per-host data loading make the
+same code span pods, with DCN between slices (replaces the reference's
+absent launcher).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None, spatial: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh of shape (data = n/spatial, spatial)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    assert n % spatial == 0, (n, spatial)
+    arr = np.asarray(devices).reshape(n // spatial, spatial)
+    return Mesh(arr, ("data", "spatial"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Images/flow (B, H, W, C): batch over 'data', height over 'spatial'."""
+    return NamedSharding(mesh, P("data", "spatial", None, None))
+
+
+def valid_sharding(mesh: Mesh) -> NamedSharding:
+    """valid mask (B, H, W)."""
+    return NamedSharding(mesh, P("data", "spatial", None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: dict, mesh: Mesh) -> dict:
+    """Device-put a host batch dict onto the mesh with train shardings."""
+    out = {}
+    for k, v in batch.items():
+        if v.ndim == 4:
+            out[k] = jax.device_put(v, batch_sharding(mesh))
+        elif v.ndim == 3:
+            out[k] = jax.device_put(v, valid_sharding(mesh))
+        else:
+            out[k] = jax.device_put(v, replicated(mesh))
+    return out

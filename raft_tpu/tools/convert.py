@@ -1,0 +1,185 @@
+"""PyTorch ``.pth`` checkpoint → flax variables converter.
+
+The reference saves ``nn.DataParallel`` state dicts — every key carries a
+``module.`` prefix (train.py:187; consumers wrap in DataParallel *before*
+loading, evaluate.py:178-179). This converter:
+
+- strips the ``module.`` prefix,
+- transposes conv kernels OIHW → HWIO,
+- maps norm params (weight/bias → scale/bias) and BatchNorm running stats
+  into the ``batch_stats`` collection,
+- drops ``num_batches_tracked`` and the duplicated ``downsample.1`` norm
+  entries (torch registers the same norm module under both ``normN`` and
+  ``downsample.1`` — extractor.py:44-45,103-104).
+
+The mapping is derived by walking the *flax* variable tree and computing each
+param's torch key, so missing/mismatched keys fail loudly.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import RAFT
+
+
+def _flax_path_to_torch_key(path: Tuple[str, ...], collection: str) -> str:
+    """('fnet','layer1_0','conv1','kernel') -> 'fnet.layer1.0.conv1.weight'."""
+    parts = []
+    for comp in path[:-1]:
+        m = re.fullmatch(r"layer(\d)_(\d)", comp)
+        if m:
+            parts.append(f"layer{m.group(1)}.{m.group(2)}")
+        elif comp == "downsample_conv":
+            parts.append("downsample.0")
+        elif comp == "mask_conv1":
+            parts.append("mask.0")
+        elif comp == "mask_conv2":
+            parts.append("mask.2")
+        elif comp == "norm":
+            continue  # flax Norm wrapper level, absent in torch
+        else:
+            parts.append(comp)
+
+    leaf = path[-1]
+    if collection == "batch_stats":
+        leaf = {"mean": "running_mean", "var": "running_var"}[leaf]
+    else:
+        leaf = {"kernel": "weight", "scale": "weight", "bias": "bias"}[leaf]
+    return ".".join(parts + [leaf])
+
+
+def _convert_value(path: Tuple[str, ...], value: np.ndarray,
+                   target_shape) -> np.ndarray:
+    if path[-1] == "kernel":
+        value = np.transpose(value, (2, 3, 1, 0))  # OIHW -> HWIO
+    value = np.asarray(value, dtype=np.float32)
+    if tuple(value.shape) != tuple(target_shape):
+        raise ValueError(
+            f"shape mismatch at {'/'.join(path)}: torch {value.shape} "
+            f"vs flax {tuple(target_shape)}")
+    return value
+
+
+def torch_key_map(variables: Dict[str, Any]) -> Dict[str, Tuple[str, Tuple[str, ...]]]:
+    """torch key -> (collection, flax path) for every param in ``variables``."""
+    mapping = {}
+    for collection in ("params", "batch_stats"):
+        if collection not in variables:
+            continue
+        flat = jax.tree_util.tree_flatten_with_path(variables[collection])[0]
+        for keypath, leaf in flat:
+            path = tuple(k.key for k in keypath)
+            tkey = _flax_path_to_torch_key(path, collection)
+            mapping[tkey] = (collection, path)
+    return mapping
+
+
+def convert_state_dict(state_dict: Dict[str, np.ndarray],
+                       variables: Dict[str, Any]) -> Dict[str, Any]:
+    """Fill a flax variable tree with values from a torch state dict.
+
+    ``state_dict`` values may be torch tensors or numpy arrays.
+    """
+    sd = {}
+    for k, v in state_dict.items():
+        k = k.removeprefix("module.")
+        if k.endswith("num_batches_tracked"):
+            continue
+        if ".downsample.1." in k:
+            continue  # duplicate of normN (see module docstring)
+        if hasattr(v, "detach"):
+            v = v.detach().cpu().numpy()
+        sd[k] = np.asarray(v)
+
+    mapping = torch_key_map(variables)
+
+    missing = sorted(set(mapping) - set(sd))
+    unexpected = sorted(set(sd) - set(mapping))
+    if missing:
+        raise KeyError(f"state dict missing {len(missing)} keys, e.g. "
+                       f"{missing[:5]}")
+    if unexpected:
+        raise KeyError(f"state dict has {len(unexpected)} unmapped keys, "
+                       f"e.g. {unexpected[:5]}")
+
+    out = {c: {} for c in variables}
+    flat_out: Dict[str, Dict[Tuple[str, ...], jnp.ndarray]] = {
+        c: {} for c in variables}
+    for tkey, (collection, path) in mapping.items():
+        target = variables[collection]
+        for comp in path:
+            target = target[comp]
+        flat_out[collection][path] = jnp.asarray(
+            _convert_value(path, sd[tkey], target.shape))
+
+    for collection, flat in flat_out.items():
+        tree: Dict[str, Any] = {}
+        for path, value in flat.items():
+            node = tree
+            for comp in path[:-1]:
+                node = node.setdefault(comp, {})
+            node[path[-1]] = value
+        out[collection] = tree
+    # preserve any collections without torch counterparts (shouldn't happen)
+    for c in variables:
+        if c not in out or not out[c]:
+            out[c] = variables[c]
+    return out
+
+
+def load_pth(path: str, config: RAFTConfig,
+             image_hw: Tuple[int, int] = (64, 64)) -> Dict[str, Any]:
+    """Load a reference ``.pth`` into flax variables for ``config``."""
+    import torch
+
+    state_dict = torch.load(path, map_location="cpu", weights_only=True)
+    model = RAFT(config)
+    img = jnp.zeros((1, *image_hw, 3))
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+    return convert_state_dict(state_dict, variables)
+
+
+def save_converted(variables: Dict[str, Any], out_path: str) -> None:
+    """Serialize converted variables with flax msgpack."""
+    from flax import serialization
+
+    with open(out_path, "wb") as f:
+        f.write(serialization.to_bytes(variables))
+
+
+def load_converted(path: str, config: RAFTConfig,
+                   image_hw: Tuple[int, int] = (64, 64)) -> Dict[str, Any]:
+    from flax import serialization
+
+    model = RAFT(config)
+    img = jnp.zeros((1, *image_hw, 3))
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+    with open(path, "rb") as f:
+        return serialization.from_bytes(variables, f.read())
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Convert reference RAFT .pth checkpoints to flax msgpack")
+    p.add_argument("input", help="path to .pth file")
+    p.add_argument("output", help="path to write .msgpack")
+    p.add_argument("--small", action="store_true")
+    args = p.parse_args(argv)
+
+    cfg = RAFTConfig(small=args.small)
+    variables = load_pth(args.input, cfg)
+    save_converted(variables, args.output)
+    print(f"converted {args.input} -> {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+from raft_tpu.training.loss import sequence_loss  # noqa: F401
+from raft_tpu.training.optim import (  # noqa: F401
+    make_optimizer,
+    onecycle_linear_schedule,
+)
+from raft_tpu.training.train_step import (  # noqa: F401
+    RAFTTrainState,
+    create_train_state,
+    make_train_step,
+)

@@ -1,0 +1,54 @@
+"""Sequence loss over iterative flow predictions (train.py:47-72)."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import MAX_FLOW
+
+
+def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
+                  valid: jax.Array, gamma: float = 0.8,
+                  max_flow: float = MAX_FLOW
+                  ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """γ-weighted L1 over all iteration outputs.
+
+    flow_preds: (T, B, H, W, 2) — scan-stacked predictions.
+    flow_gt:    (B, H, W, 2); valid: (B, H, W).
+
+    Pixels that are invalid or whose GT magnitude >= ``max_flow`` are
+    excluded (train.py:53-55). The per-iteration weight is
+    gamma**(T-1-i) (train.py:58), and — matching the reference exactly —
+    the masked L1 is averaged over ALL elements, not just valid ones
+    (``(valid[:, None] * i_loss).mean()``, train.py:60).
+    """
+    T = flow_preds.shape[0]
+    mag = jnp.sqrt(jnp.sum(flow_gt ** 2, axis=-1))
+    valid = (valid >= 0.5) & (mag < max_flow)          # (B, H, W)
+    vmask = valid[None, ..., None].astype(jnp.float32)  # (1, B, H, W, 1)
+
+    i = jnp.arange(T, dtype=jnp.float32)
+    weights = gamma ** (T - 1 - i)                     # (T,)
+
+    l1 = jnp.abs(flow_preds - flow_gt[None])           # (T, B, H, W, 2)
+    per_iter = (vmask * l1).mean(axis=(1, 2, 3, 4))    # (T,)
+    flow_loss = jnp.sum(weights * per_iter)
+
+    # metrics on the final prediction, valid pixels only (train.py:62-70)
+    epe = jnp.sqrt(jnp.sum((flow_preds[-1] - flow_gt) ** 2, axis=-1))
+    vf = valid.astype(jnp.float32)
+    count = jnp.maximum(vf.sum(), 1.0)
+
+    def vmean(x):
+        return (x * vf).sum() / count
+
+    metrics = {
+        "epe": vmean(epe),
+        "1px": vmean((epe < 1).astype(jnp.float32)),
+        "3px": vmean((epe < 3).astype(jnp.float32)),
+        "5px": vmean((epe < 5).astype(jnp.float32)),
+    }
+    return flow_loss, metrics

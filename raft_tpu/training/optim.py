@@ -1,0 +1,46 @@
+"""Optimizer factory: AdamW + linear OneCycle + global-norm clip.
+
+Replicates ``fetch_optimizer`` (train.py:79-86): AdamW(lr, wdecay, eps) with
+OneCycleLR(total=num_steps+100, pct_start=0.05, anneal='linear') and
+clip_grad_norm(1.0) applied before the step (train.py:176-177).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def onecycle_linear_schedule(peak_lr: float, total_steps: int,
+                             pct_start: float = 0.05,
+                             div_factor: float = 25.0,
+                             final_div_factor: float = 1e4):
+    """torch OneCycleLR with anneal_strategy='linear'.
+
+    Phase 1 (first ``pct_start`` of steps): linear ``peak/div_factor`` → peak.
+    Phase 2: linear peak → ``initial/final_div_factor``.
+    """
+    initial = peak_lr / div_factor
+    final = initial / final_div_factor
+    warm = float(max(1, round(pct_start * total_steps)))
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = initial + (peak_lr - initial) * (step / warm)
+        frac = (step - warm) / max(total_steps - warm, 1.0)
+        down = peak_lr + (final - peak_lr) * frac
+        return jnp.where(step < warm, up, jnp.minimum(down, peak_lr))
+
+    return schedule
+
+
+def make_optimizer(lr: float, num_steps: int, wdecay: float = 1e-4,
+                   epsilon: float = 1e-8, clip: float = 1.0):
+    """AdamW + OneCycle + clip, matching the reference trainer."""
+    schedule = onecycle_linear_schedule(lr, num_steps + 100)
+    tx = optax.chain(
+        optax.clip_by_global_norm(clip),
+        optax.adamw(schedule, b1=0.9, b2=0.999, eps=epsilon,
+                    weight_decay=wdecay),
+    )
+    return tx, schedule

@@ -1,0 +1,142 @@
+"""Correlation volume/lookup parity vs a torch oracle.
+
+The oracle reproduces the reference semantics (corr.py:12-60) from torch
+primitives: all-pairs matmul / sqrt(dim), avg_pool2d pyramid, and per-level
+grid_sample at coords/2^i + window offsets — including the reference's
+channel-order quirk where the x coordinate gets the OUTER meshgrid offset
+(corr.py:39-43; same x-major order as the CUDA kernel's
+``(iy-1) + rd*(ix-1)`` scatter, correlation_kernel.cu:92-95).
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from raft_tpu.models.corr import (
+    AlternateCorrBlock,
+    CorrBlock,
+    all_pairs_correlation,
+    build_corr_pyramid,
+    corr_lookup,
+)
+
+
+def torch_corr_oracle(fmap1, fmap2, coords, num_levels, radius):
+    """Reference-semantics corr lookup, NCHW torch. Returns (B, L*K^2, H, W)."""
+    B, C, H, W = fmap1.shape
+    f1 = fmap1.reshape(B, C, H * W)
+    f2 = fmap2.reshape(B, C, H * W)
+    corr = torch.matmul(f1.transpose(1, 2), f2) / np.sqrt(C)
+    corr = corr.reshape(B * H * W, 1, H, W)
+
+    pyramid = [corr]
+    for _ in range(num_levels - 1):
+        corr = F.avg_pool2d(corr, 2, stride=2)
+        pyramid.append(corr)
+
+    r = radius
+    coords_p = coords.permute(0, 2, 3, 1)  # (B, H, W, 2) xy
+    out = []
+    for i, c in enumerate(pyramid):
+        d = torch.linspace(-r, r, 2 * r + 1)
+        # reference quirk: meshgrid(dy, dx) added to (x, y) -> x gets the
+        # outer offset
+        delta = torch.stack(torch.meshgrid(d, d, indexing="ij"), dim=-1)
+        centroid = coords_p.reshape(B * H * W, 1, 1, 2) / 2 ** i
+        pos = centroid + delta.reshape(1, 2 * r + 1, 2 * r + 1, 2)
+        hw = c.shape[-2:]
+        gx = 2 * pos[..., 0] / (hw[1] - 1) - 1
+        gy = 2 * pos[..., 1] / (hw[0] - 1) - 1
+        grid = torch.stack([gx, gy], dim=-1)
+        samp = F.grid_sample(c, grid, align_corners=True)
+        out.append(samp.reshape(B, H, W, -1))
+    return torch.cat(out, dim=-1).permute(0, 3, 1, 2)
+
+
+@pytest.fixture(scope="module")
+def fmaps(request):
+    # smallest level is (H/8, W/8); keep >= 2 px so the torch oracle's
+    # grid_sample normalization (divide by dim-1) stays finite.
+    rng = np.random.RandomState(7)
+    B, H, W, C = 2, 16, 24, 8
+    f1 = rng.randn(B, H, W, C).astype(np.float32)
+    f2 = rng.randn(B, H, W, C).astype(np.float32)
+    return f1, f2
+
+
+class TestAllPairs:
+    def test_vs_torch_matmul(self, fmaps):
+        f1, f2 = fmaps
+        B, H, W, C = f1.shape
+        got = np.asarray(all_pairs_correlation(jnp.asarray(f1), jnp.asarray(f2)))
+        t1 = torch.from_numpy(f1).permute(0, 3, 1, 2).reshape(B, C, H * W)
+        t2 = torch.from_numpy(f2).permute(0, 3, 1, 2).reshape(B, C, H * W)
+        want = (torch.matmul(t1.transpose(1, 2), t2) / np.sqrt(C)).reshape(
+            B, H * W, H, W).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+class TestCorrLookup:
+    @pytest.mark.parametrize("radius", [3, 4])
+    def test_vs_reference_oracle(self, fmaps, radius):
+        f1, f2 = fmaps
+        B, H, W, C = f1.shape
+        rng = np.random.RandomState(3)
+        # coords near the grid with some displacement, some OOB
+        base = np.stack(np.meshgrid(np.arange(W), np.arange(H),
+                                    indexing="xy"), axis=-1)
+        coords = (base[None] + rng.uniform(-3, 3, size=(B, H, W, 2))
+                  ).astype(np.float32)
+
+        block = CorrBlock(jnp.asarray(f1), jnp.asarray(f2), 4, radius)
+        got = np.asarray(block(jnp.asarray(coords)))  # (B, H, W, L*K^2)
+
+        t1 = torch.from_numpy(f1).permute(0, 3, 1, 2)
+        t2 = torch.from_numpy(f2).permute(0, 3, 1, 2)
+        tc = torch.from_numpy(coords).permute(0, 3, 1, 2)
+        want = torch_corr_oracle(t1, t2, tc, 4, radius)
+        want = want.permute(0, 2, 3, 1).numpy()
+
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+    def test_alternate_path_matches_main(self, fmaps):
+        f1, f2 = fmaps
+        B, H, W, C = f1.shape
+        rng = np.random.RandomState(5)
+        base = np.stack(np.meshgrid(np.arange(W), np.arange(H),
+                                    indexing="xy"), axis=-1)
+        coords = jnp.asarray(
+            (base[None] + rng.uniform(-2, 2, size=(B, H, W, 2))
+             ).astype(np.float32))
+
+        main = CorrBlock(jnp.asarray(f1), jnp.asarray(f2), 4, 4)(coords)
+        alt = AlternateCorrBlock(jnp.asarray(f1), jnp.asarray(f2), 4, 4,
+                                 chunk=32)(coords)
+        np.testing.assert_allclose(np.asarray(alt), np.asarray(main),
+                                   atol=1e-4, rtol=1e-3)
+
+    def test_pyramid_shapes_odd(self):
+        """Odd sizes floor-divide down the pyramid like avg_pool2d."""
+        f = jnp.ones((1, 55, 13, 4))
+        pyr = build_corr_pyramid(f, f, 4)
+        assert [p.shape[2:] for p in pyr] == [
+            (55, 13), (27, 6), (13, 3), (6, 1)]
+
+    def test_channel_order_x_major(self):
+        """Peak at displacement (dx=+1, dy=0) lights channel (1+r)*K + r."""
+        H, W, C = 8, 8, 4
+        f1 = np.zeros((1, H, W, C), np.float32)
+        f2 = np.zeros((1, H, W, C), np.float32)
+        f1[0, 4, 4] = 1.0
+        f2[0, 4, 5] = 1.0  # feature moved +1 in x
+        r = 4
+        block = CorrBlock(jnp.asarray(f1), jnp.asarray(f2), 1, r)
+        base = np.stack(np.meshgrid(np.arange(W), np.arange(H),
+                                    indexing="xy"), axis=-1)[None]
+        out = np.asarray(block(jnp.asarray(base.astype(np.float32))))
+        K = 2 * r + 1
+        expect_ch = (1 + r) * K + r  # du=+1 outer, dv=0 inner
+        assert out[0, 4, 4].argmax() == expect_ch

@@ -1,0 +1,141 @@
+"""Model-level tests: parameter parity, shapes, autodiff structure, BN modes."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import RAFT
+
+
+def n_params(tree):
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(tree))
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    model = RAFT(RAFTConfig(small=True))
+    img = jnp.zeros((1, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def basic_model():
+    model = RAFT(RAFTConfig(small=False))
+    img = jnp.zeros((1, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+    return model, variables
+
+
+class TestParameterParity:
+    def test_small_param_count(self, small_model):
+        """~1.0M params (BASELINE.md; exact count pinned here)."""
+        _, variables = small_model
+        assert n_params(variables["params"]) == 990_162
+
+    def test_basic_param_count(self, basic_model):
+        """~5.3M params (BASELINE.md; exact count pinned here)."""
+        _, variables = basic_model
+        assert n_params(variables["params"]) == 5_257_536
+
+    def test_basic_has_batch_stats(self, basic_model):
+        """cnet uses BatchNorm (core/raft.py:55) -> batch_stats collection."""
+        _, variables = basic_model
+        assert "batch_stats" in variables
+
+    def test_small_has_no_batch_stats(self, small_model):
+        """small cnet is norm-free, fnet instance (core/raft.py:49-50)."""
+        _, variables = small_model
+        assert "batch_stats" not in variables
+
+    def test_expected_top_level_modules(self, basic_model):
+        _, variables = basic_model
+        assert set(variables["params"].keys()) == {
+            "fnet", "cnet", "update_block"}
+
+
+class TestForward:
+    def test_train_mode_returns_all_iterations(self, small_model):
+        model, variables = small_model
+        img = jnp.ones((2, 32, 32, 3)) * 128
+        out = model.apply(variables, img, img, iters=3)
+        assert out.shape == (3, 2, 32, 32, 2)
+
+    def test_test_mode_returns_low_and_up(self, small_model):
+        model, variables = small_model
+        img = jnp.ones((1, 32, 32, 3)) * 128
+        lo, up = model.apply(variables, img, img, iters=2, test_mode=True)
+        assert lo.shape == (1, 4, 4, 2)
+        assert up.shape == (1, 32, 32, 2)
+
+    def test_flow_init_shifts_prediction(self, small_model):
+        model, variables = small_model
+        rng = np.random.RandomState(0)
+        img1 = jnp.asarray(rng.rand(1, 32, 32, 3).astype(np.float32) * 255)
+        img2 = jnp.asarray(rng.rand(1, 32, 32, 3).astype(np.float32) * 255)
+        lo0, _ = model.apply(variables, img1, img2, iters=1, test_mode=True)
+        init = jnp.ones((1, 4, 4, 2)) * 2.0
+        lo1, _ = model.apply(variables, img1, img2, iters=1, test_mode=True,
+                             flow_init=init)
+        assert float(jnp.abs(lo1 - lo0).max()) > 0.1
+
+    def test_identical_images_near_zero_flow(self, basic_model):
+        """Same image both sides at init weights -> tiny flow magnitudes."""
+        model, variables = basic_model
+        rng = np.random.RandomState(1)
+        img = jnp.asarray(rng.rand(1, 32, 32, 3).astype(np.float32) * 255)
+        _, up = model.apply(variables, img, img, iters=4, test_mode=True)
+        assert bool(jnp.isfinite(up).all())
+
+    def test_mixed_precision_forward(self):
+        model = RAFT(RAFTConfig(small=True, mixed_precision=True))
+        img = jnp.ones((1, 32, 32, 3)) * 100
+        variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+        out = model.apply(variables, img, img, iters=2)
+        assert out.dtype == jnp.float32  # upsample is an fp32 island
+        assert bool(jnp.isfinite(out).all())
+
+
+class TestAutodiff:
+    def test_gradients_finite_and_nonzero(self, small_model):
+        model, variables = small_model
+        rng = np.random.RandomState(0)
+        img1 = jnp.asarray(rng.rand(1, 32, 32, 3).astype(np.float32) * 255)
+        img2 = jnp.asarray(rng.rand(1, 32, 32, 3).astype(np.float32) * 255)
+        gt = jnp.asarray(rng.randn(1, 32, 32, 2).astype(np.float32))
+
+        def loss_fn(params):
+            preds = model.apply({"params": params}, img1, img2, iters=2)
+            return jnp.abs(preds - gt[None]).mean()
+
+        grads = jax.grad(loss_fn)(variables["params"])
+        leaves = jax.tree.leaves(grads)
+        assert all(bool(jnp.isfinite(g).all()) for g in leaves)
+        # every major module receives gradient
+        for key in ("fnet", "cnet", "update_block"):
+            sub = jax.tree.leaves(grads[key])
+            assert any(float(jnp.abs(g).max()) > 0 for g in sub), key
+
+
+class TestBatchNormModes:
+    def test_train_updates_stats_freeze_does_not(self, basic_model):
+        model, variables = basic_model
+        rng = np.random.RandomState(0)
+        img = jnp.asarray(rng.rand(1, 32, 32, 3).astype(np.float32) * 255)
+
+        _, mutated = model.apply(variables, img, img, iters=1, train=True,
+                                 mutable=["batch_stats"])
+        diffs = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()),
+                             mutated["batch_stats"],
+                             variables["batch_stats"])
+        assert max(jax.tree.leaves(diffs)) > 0
+
+        _, frozen = model.apply(variables, img, img, iters=1, train=True,
+                                freeze_bn=True, mutable=["batch_stats"])
+        diffs = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()),
+                             frozen["batch_stats"],
+                             variables["batch_stats"])
+        assert max(jax.tree.leaves(diffs)) == 0
