@@ -1,0 +1,102 @@
+"""Headline benchmark: RAFT-basic training throughput on one TPU chip.
+
+Mirrors the reference's FlyingChairs stage (``train_standard.sh:3``: crop
+368x496, 12 refinement iterations, AdamW + OneCycle, sequence loss) as a
+jit-compiled bf16 train step, and reports sustained image-pairs/sec.
+
+Baseline: the reference publishes no numbers (BASELINE.md). The committed
+target is "beat 2xV100 FlyingChairs wall-clock" — public RAFT training logs
+put the 2-GPU recipe at ~2 steps/s with batch 10, i.e. ~20 img-pairs/s, so
+``vs_baseline`` is value/20 for the whole 2-GPU reference rig (not per GPU).
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": "img_pairs_per_sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# persistent compile cache: repeat bench runs skip the multi-minute compile
+jax.config.update("jax_compilation_cache_dir", "/tmp/raft_tpu_jax_cache_tpu")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+BASELINE_PAIRS_PER_SEC = 20.0  # est. 2xV100 reference recipe (see docstring)
+IMAGE_HW = (368, 496)          # train_standard.sh chairs crop
+ITERS = 12                     # train.py:232
+WARMUP_STEPS = 3
+TIMED_STEPS = 12
+
+
+def build(batch_size):
+    from raft_tpu.config import RAFTConfig, stage_config
+    from raft_tpu.training.train_step import (create_train_state,
+                                              make_train_step)
+
+    model_cfg = RAFTConfig(small=False, mixed_precision=True)
+    train_cfg = stage_config("chairs", batch_size=batch_size)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(model_cfg, train_cfg, rng, image_hw=IMAGE_HW)
+    step = jax.jit(make_train_step(model_cfg, train_cfg), donate_argnums=(0,))
+
+    h, w = IMAGE_HW
+    host = np.random.RandomState(0)
+    batch = {
+        "image1": jnp.asarray(
+            host.rand(batch_size, h, w, 3).astype(np.float32) * 255.0),
+        "image2": jnp.asarray(
+            host.rand(batch_size, h, w, 3).astype(np.float32) * 255.0),
+        "flow": jnp.asarray(
+            host.randn(batch_size, h, w, 2).astype(np.float32)),
+        "valid": jnp.ones((batch_size, h, w), jnp.float32),
+    }
+    return state, step, batch, rng
+
+
+def run(batch_size):
+    state, step, batch, rng = build(batch_size)
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, batch, rng)
+    jax.block_until_ready(metrics)
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, metrics = step(state, batch, rng)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+    return batch_size * TIMED_STEPS / dt
+
+
+def main():
+    value = None
+    used_batch = None
+    for batch_size in (10, 6, 4, 2, 1):
+        try:
+            value = run(batch_size)
+            used_batch = batch_size
+            break
+        except Exception as exc:  # OOM at this shape -> try smaller batch
+            print(f"batch {batch_size} failed: {exc}", file=sys.stderr)
+    if value is None:
+        print(json.dumps({
+            "metric": "raft_basic_train_chairs_368x496_failed",
+            "value": 0.0, "unit": "img_pairs_per_sec", "vs_baseline": 0.0,
+        }))
+        return
+    print(json.dumps({
+        "metric": (f"raft_basic_train_chairs_368x496_bf16_b{used_batch}"
+                   f"_iters{ITERS}_1chip"),
+        "value": round(value, 3),
+        "unit": "img_pairs_per_sec",
+        "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""Command-line entry points (the reference's L5 scripts as a package).
+
+The reference's ``train.py``/``evaluate.py``/``demo.py``/``test_trt.py`` all
+``sys.path.append('core')`` into an uninstalled tree (train.py:3 etc.); here
+each is a proper module:
+
+    python -m raft_tpu.cli.train --name raft-chairs --stage chairs ...
+    python -m raft_tpu.cli.evaluate --model ckpt.msgpack --dataset sintel
+    python -m raft_tpu.cli.demo --model ckpt.msgpack --path frames/ --out out/
+    python -m raft_tpu.cli.export --model ckpt.msgpack --out engine_dir/
+    python -m raft_tpu.cli.curriculum --name raft  # train_standard.sh analog
+"""

@@ -1,0 +1,110 @@
+"""Correlation-lookup benchmark + parity harness.
+
+The ``test_trt.py:52-99`` discipline (same inputs, two backends, numeric
+diff + wall-clock with explicit fences) applied to the corr-lookup backends:
+
+- ``gather``: flattened-index 4-corner take_along_axis (XLA)
+- ``onehot``: one-hot window GEMMs on the MXU (XLA)
+- ``pallas``: double-buffered window-DMA kernel (TPU only)
+- ``alt``:    on-the-fly blockwise correlation (alt_cuda_corr analog)
+
+Run on the real chip:  python -m raft_tpu.cli.corr_bench --hw 46 62
+(46x62 = the 368x496 chairs crop at stride 8; use 128 128 for the KITTI/TRT
+max envelope).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def bench_fn(fn, args, warmup=2, iters=20):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters, out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="corr lookup backend shootout")
+    p.add_argument("--batch", type=int, default=6)
+    p.add_argument("--hw", type=int, nargs=2, default=[46, 62],
+                   help="feature-map H W (input/8)")
+    p.add_argument("--dim", type=int, default=256)
+    p.add_argument("--radius", type=int, default=4)
+    p.add_argument("--levels", type=int, default=4)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--impls", nargs="+",
+                   default=["gather", "onehot", "pallas", "alt"])
+    args = p.parse_args(argv)
+
+    from raft_tpu.kernels import corr_lookup_pallas, pallas_available
+    from raft_tpu.models.corr import (alt_corr_lookup, build_corr_pyramid,
+                                      corr_lookup, corr_lookup_onehot)
+    from raft_tpu.ops.pooling import avg_pool2x2
+
+    B, (H, W), C = args.batch, args.hw, args.dim
+    rng = np.random.RandomState(0)
+    fmap1 = jnp.asarray(rng.randn(B, H, W, C).astype(np.float32))
+    fmap2 = jnp.asarray(rng.randn(B, H, W, C).astype(np.float32))
+    base = np.stack(np.meshgrid(np.arange(W), np.arange(H)), -1)
+    coords = jnp.asarray(base[None].astype(np.float32)
+                         + rng.randn(B, H, W, 2).astype(np.float32) * 4)
+
+    pyramid = jax.block_until_ready(
+        tuple(build_corr_pyramid(fmap1, fmap2, args.levels)))
+    f2_pyr = [fmap2]
+    for _ in range(args.levels - 1):
+        f2_pyr.append(avg_pool2x2(f2_pyr[-1]))
+    f2_pyr = jax.block_until_ready(tuple(f2_pyr))
+
+    lookups = {
+        "gather": jax.jit(lambda c: corr_lookup(pyramid, c, args.radius)),
+        "onehot": jax.jit(
+            lambda c: corr_lookup_onehot(pyramid, c, args.radius)),
+        "pallas": jax.jit(
+            lambda c: corr_lookup_pallas(pyramid, c, args.radius)),
+        "alt": jax.jit(
+            lambda c: alt_corr_lookup(fmap1, f2_pyr, c, args.radius)),
+    }
+
+    reference = None
+    results = {}
+    for name in args.impls:
+        if name == "pallas" and not pallas_available():
+            print(f"{name:>8}: skipped (no TPU backend)")
+            continue
+        try:
+            dt, out = bench_fn(lookups[name], (coords,), iters=args.iters)
+        except Exception as e:
+            print(f"{name:>8}: FAILED {type(e).__name__}: {e}")
+            continue
+        out = np.asarray(out)
+        if reference is None:
+            reference = out
+            diff = 0.0
+        else:
+            diff = float(np.abs(out - reference).max())
+        results[name] = dt
+        queries_per_s = B * H * W / dt
+        print(f"{name:>8}: {dt * 1e3:8.3f} ms  "
+              f"{queries_per_s / 1e6:8.2f} Mquery/s  max|Δ|={diff:.2e}")
+
+    if results:
+        fastest = min(results, key=results.get)
+        print(f"fastest: {fastest}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Curriculum driver — the ``train_standard.sh`` / ``train_mixed.sh`` analog.
+
+Runs the 4-stage C -> T -> S -> K recipe (train_standard.sh:3-6), each stage
+restoring the previous stage's final weights with a fresh LR schedule, which
+is exactly how the shell scripts chain ``--restore_ckpt`` (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from raft_tpu.config import RAFTConfig
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="RAFT 4-stage curriculum on TPU")
+    p.add_argument("--name", default="raft")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed", action="store_true",
+                   help="train_mixed.sh presets + bf16 compute")
+    p.add_argument("--stages", nargs="+",
+                   default=["chairs", "things", "sintel", "kitti"])
+    p.add_argument("--num_steps", type=int, default=None,
+                   help="override steps per stage (smoke runs)")
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--data_root", default="datasets")
+    p.add_argument("--checkpoint_dir", default="checkpoints")
+    args = p.parse_args(argv)
+
+    from raft_tpu.training.trainer import train_curriculum
+
+    model_cfg = RAFTConfig(small=args.small, mixed_precision=args.mixed)
+    overrides = dict(data_root=args.data_root,
+                     checkpoint_dir=args.checkpoint_dir)
+    if args.num_steps is not None:
+        overrides["num_steps"] = args.num_steps
+    if args.batch_size is not None:
+        overrides["batch_size"] = args.batch_size
+    train_curriculum(args.stages, model_cfg, name=args.name,
+                     mixed=args.mixed, **overrides)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Demo CLI — the ``demo.py`` analog, headless.
+
+Globs a frame directory, runs consecutive-pair flow at ``iters=20``
+(demo.py:62), and writes side-by-side image/flow PNGs instead of
+``cv2.imshow`` (demo.py:26-39) so it runs on TPU VMs without a display.
+Keeps the fork's fixed color normalization (rad=3,
+core/utils/flow_viz.py:128-130) so colors are frame-to-frame consistent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+
+import numpy as np
+from PIL import Image
+
+import jax.numpy as jnp
+
+from raft_tpu.config import ITERS_DEMO, RAFTConfig
+from raft_tpu.ops.padding import InputPadder
+from raft_tpu.utils.flow_viz import flow_to_image
+
+
+def load_image(path: str) -> jnp.ndarray:
+    """PIL -> (1, H, W, 3) float32 device array (demo.py:20-23)."""
+    img = np.array(Image.open(path)).astype(np.uint8)
+    return jnp.asarray(img, jnp.float32)[None]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="RAFT demo on a frame directory")
+    p.add_argument("--model", required=True, help=".pth or .msgpack weights")
+    p.add_argument("--path", required=True, help="directory of frames")
+    p.add_argument("--out", default="demo_out", help="output directory")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--iters", type=int, default=ITERS_DEMO)
+    args = p.parse_args(argv)
+
+    from raft_tpu.evaluation.evaluate import make_forward
+    from raft_tpu.training.trainer import load_weights
+
+    cfg = RAFTConfig(small=args.small, mixed_precision=args.mixed_precision,
+                     alternate_corr=args.alternate_corr)
+    variables = load_weights(args.model, cfg)
+    fwd, _ = make_forward(cfg, args.iters)
+
+    images = sorted(glob.glob(os.path.join(args.path, "*.png"))
+                    + glob.glob(os.path.join(args.path, "*.jpg")))
+    os.makedirs(args.out, exist_ok=True)
+
+    for imfile1, imfile2 in zip(images[:-1], images[1:]):
+        image1 = load_image(imfile1)
+        image2 = load_image(imfile2)
+        padder = InputPadder(image1.shape)
+        im1, im2 = padder.pad(image1, image2)
+        _, flow_up = fwd(variables, im1, im2)
+        flow = np.asarray(padder.unpad(flow_up)[0])
+
+        # side-by-side frame/flow, the viz() analog (demo.py:26-39)
+        img = np.asarray(image1[0]).astype(np.uint8)
+        flo = flow_to_image(flow)
+        pair = np.concatenate([img, flo], axis=0)
+        name = os.path.splitext(os.path.basename(imfile1))[0] + "_flow.png"
+        Image.fromarray(pair).save(os.path.join(args.out, name))
+        print(f"{imfile1} -> {os.path.join(args.out, name)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

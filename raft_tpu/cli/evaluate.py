@@ -1,0 +1,48 @@
+"""Evaluation CLI — the ``evaluate.py:169-195`` analog."""
+
+from __future__ import annotations
+
+import argparse
+
+from raft_tpu.config import RAFTConfig
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="Validate RAFT checkpoints")
+    p.add_argument("--model", required=True, help=".pth or .msgpack weights")
+    p.add_argument("--dataset", required=True,
+                   choices=["chairs", "sintel", "kitti"])
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--data_root", default="datasets")
+    p.add_argument("--submission", action="store_true",
+                   help="write a leaderboard submission instead of validating")
+    args = p.parse_args(argv)
+
+    from raft_tpu.evaluation import evaluate as ev
+    from raft_tpu.training.trainer import load_weights
+
+    cfg = RAFTConfig(small=args.small, mixed_precision=args.mixed_precision,
+                     alternate_corr=args.alternate_corr)
+    variables = load_weights(args.model, cfg)
+
+    if args.submission:
+        if args.dataset == "sintel":
+            ev.create_sintel_submission(variables, cfg, warm_start=True,
+                                        data_root=args.data_root)
+        elif args.dataset == "kitti":
+            ev.create_kitti_submission(variables, cfg,
+                                       data_root=args.data_root)
+        else:
+            p.error("submissions exist for sintel/kitti only")
+        return
+
+    fn = {"chairs": ev.validate_chairs, "sintel": ev.validate_sintel,
+          "kitti": ev.validate_kitti}[args.dataset]
+    results = fn(variables, cfg, data_root=args.data_root)
+    print(results)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Export CLI — delegates to :mod:`raft_tpu.serving.export` (test_trt.py
+``--gen_onnx`` analog)."""
+
+from raft_tpu.serving.export import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Backend parity + timing harness — the ``test_trt.py:52-99`` analog.
+
+Runs the same frame pairs through (a) the plain jitted model and (b) the
+AOT shape-bucket engine, reports per-pair wall clock for both (with
+``block_until_ready`` fences standing in for ``cuda.synchronize``) and the
+max flow difference, and optionally writes the stacked side-by-side
+visualization video (raft_trt_utils.py:24-51 analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import time
+
+import numpy as np
+from PIL import Image
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import ITERS_EXPORT, RAFTConfig
+from raft_tpu.ops.padding import InputPadder
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="jit vs AOT-engine parity")
+    p.add_argument("--model", required=True, help=".pth or .msgpack weights")
+    p.add_argument("--path", required=True, help="directory of frames")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--iters", type=int, default=ITERS_EXPORT)
+    p.add_argument("--video", default=None, help="optional output .avi")
+    args = p.parse_args(argv)
+
+    from raft_tpu.serving.engine import RAFTEngine
+    from raft_tpu.serving.export import make_serving_fn
+    from raft_tpu.training.trainer import load_weights
+
+    cfg = RAFTConfig(small=args.small)
+    variables = load_weights(args.model, cfg)
+    jit_fn = jax.jit(make_serving_fn(variables, cfg, args.iters))
+    engine = RAFTEngine(variables, cfg, iters=args.iters, envelope=[],
+                        precompile=False)
+
+    images = sorted(glob.glob(os.path.join(args.path, "*.png"))
+                    + glob.glob(os.path.join(args.path, "*.jpg")))
+    flows, raws = [], []
+    for f1, f2 in zip(images[:-1], images[1:]):
+        im1 = np.array(Image.open(f1)).astype(np.float32)
+        im2 = np.array(Image.open(f2)).astype(np.float32)
+
+        # path A: plain jit on the padded shape
+        i1 = jnp.asarray(im1)[None]
+        i2 = jnp.asarray(im2)[None]
+        padder = InputPadder(i1.shape)
+        p1, p2 = padder.pad(i1, i2)
+        t0 = time.perf_counter()
+        flow_jit = jax.block_until_ready(jit_fn(p1, p2))
+        t_jit = time.perf_counter() - t0
+        flow_jit = np.asarray(padder.unpad(flow_jit)[0])
+
+        # path B: AOT engine (includes its host-side pad/route)
+        t0 = time.perf_counter()
+        flow_eng = engine.infer_batch(im1[None], im2[None])[0]
+        t_eng = time.perf_counter() - t0
+
+        diff = float(np.abs(flow_jit - flow_eng).max())
+        print(f"{os.path.basename(f1)}: jit {t_jit * 1e3:7.1f} ms | "
+              f"engine {t_eng * 1e3:7.1f} ms | max|Δflow| {diff:.2e}")
+        flows.append(flow_eng)
+        raws.append(im1.astype(np.uint8))
+
+    if args.video and flows:
+        from raft_tpu.serving.video import optical_flow_visualize
+        out = optical_flow_visualize(flows, args.video, images=raws)
+        print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

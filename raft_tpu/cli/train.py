@@ -1,0 +1,84 @@
+"""Training CLI — argument surface mirrors the reference ``train.py:217-239``.
+
+Differences: ``--gpus`` is gone (the mesh uses every visible TPU chip; set
+``JAX_PLATFORMS``/``XLA_FLAGS`` to shape the device set), ``--resume``
+restores the FULL train state (capability upgrade, SURVEY.md §5), and stage
+presets fill defaults so single-stage invocations match the shell recipes.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from raft_tpu.config import RAFTConfig, TrainConfig, stage_config
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Train RAFT on TPU")
+    p.add_argument("--name", default="raft", help="name your experiment")
+    p.add_argument("--stage", default="chairs",
+                   choices=["chairs", "things", "sintel", "kitti"])
+    p.add_argument("--restore_ckpt", default=None,
+                   help=".pth or .msgpack weights to restore")
+    p.add_argument("--resume", action="store_true",
+                   help="resume full train state from the stage dir")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--validation", nargs="+", default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--num_steps", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--image_size", type=int, nargs=2, default=None)
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--mixed_schedule", action="store_true",
+                   help="use the train_mixed.sh stage presets")
+    p.add_argument("--iters", type=int, default=12)
+    p.add_argument("--wdecay", type=float, default=None)
+    p.add_argument("--epsilon", type=float, default=1e-8)
+    p.add_argument("--clip", type=float, default=1.0)
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--gamma", type=float, default=None,
+                   help="exponential weighting")
+    p.add_argument("--add_noise", action="store_true")
+    p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--data_root", default="datasets")
+    p.add_argument("--checkpoint_dir", default="checkpoints")
+    p.add_argument("--log_dir", default="runs")
+    p.add_argument("--num_workers", type=int, default=4)
+    return p
+
+
+def configs_from_args(args) -> tuple[RAFTConfig, TrainConfig]:
+    model_cfg = RAFTConfig(
+        small=args.small, dropout=args.dropout,
+        alternate_corr=args.alternate_corr,
+        mixed_precision=args.mixed_precision)
+    overrides = dict(
+        name=args.name, restore_ckpt=args.restore_ckpt, iters=args.iters,
+        epsilon=args.epsilon, clip=args.clip, add_noise=args.add_noise,
+        seed=args.seed, data_root=args.data_root,
+        checkpoint_dir=args.checkpoint_dir, log_dir=args.log_dir,
+        num_workers=args.num_workers)
+    for k in ("lr", "num_steps", "batch_size", "wdecay", "gamma"):
+        v = getattr(args, k)
+        if v is not None:
+            overrides[k] = v
+    if args.image_size is not None:
+        overrides["image_size"] = tuple(args.image_size)
+    if args.validation is not None:
+        overrides["validation"] = tuple(args.validation)
+    train_cfg = stage_config(args.stage, mixed=args.mixed_schedule,
+                             **overrides)
+    return model_cfg, train_cfg
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    from raft_tpu.training.trainer import train
+
+    model_cfg, train_cfg = configs_from_args(args)
+    train(model_cfg, train_cfg, resume=args.resume)
+
+
+if __name__ == "__main__":
+    main()

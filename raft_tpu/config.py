@@ -30,6 +30,10 @@ class RAFTConfig:
     alternate_corr: bool = False
     mixed_precision: bool = False
     corr_levels: int = 4
+    # lookup backend for the materialized pyramid: 'gather' (flattened-index
+    # take), 'onehot' (MXU one-hot GEMMs), or 'pallas' (window-DMA kernel,
+    # TPU only). Benchmark with `python -m raft_tpu.cli.corr_bench`.
+    corr_impl: str = "gather"
 
     @property
     def hidden_dim(self) -> int:
@@ -96,6 +100,10 @@ class TrainConfig:
     checkpoint_dir: str = "checkpoints"
     data_root: str = "datasets"
     log_dir: str = "runs"
+    # (start, stop): capture a jax.profiler trace over these step indices
+    # into log_dir — replaces the reference's manual cuda.synchronize
+    # timing (SURVEY.md §5 tracing/profiling)
+    profile_steps: Optional[Tuple[int, int]] = None
 
 
 # Stage presets mirroring train_standard.sh:3-6 (2-GPU fp32 recipe).

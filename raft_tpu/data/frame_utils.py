@@ -27,6 +27,11 @@ TAG_FLO = 202021.25
 
 def read_flow(path: str) -> np.ndarray:
     """Read a Middlebury .flo file -> (H, W, 2) float32."""
+    from raft_tpu import native
+
+    out = native.read_flo(path)  # GIL-free fast path when built
+    if out is not None:
+        return out
     with open(path, "rb") as f:
         magic = np.fromfile(f, np.float32, count=1)
         if magic.size == 0 or magic[0] != np.float32(TAG_FLO):
@@ -39,8 +44,12 @@ def read_flow(path: str) -> np.ndarray:
 
 def write_flow(path: str, uv: np.ndarray) -> None:
     """Write (H, W, 2) float32 flow as .flo."""
+    from raft_tpu import native
+
     uv = np.asarray(uv, np.float32)
     assert uv.ndim == 3 and uv.shape[2] == 2, uv.shape
+    if native.write_flo(path, uv):
+        return
     h, w = uv.shape[:2]
     with open(path, "wb") as f:
         np.array([TAG_FLO], np.float32).tofile(f)
@@ -50,6 +59,11 @@ def write_flow(path: str, uv: np.ndarray) -> None:
 
 
 def read_pfm(path: str) -> np.ndarray:
+    from raft_tpu import native
+
+    out = native.read_pfm(path)
+    if out is not None:
+        return out
     with open(path, "rb") as f:
         header = f.readline().rstrip()
         if header == b"PF":

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels — the ``alt_cuda_corr`` extension's successor.
+
+The reference's one native component is a CUDA correlation kernel
+(alt_cuda_corr/correlation_kernel.cu). Its TPU equivalents live here as
+Pallas kernels; selection between XLA paths and Pallas is a config knob
+(``RAFTConfig.corr_impl``) benchmarked by ``raft_tpu.cli.corr_bench``.
+"""
+
+from raft_tpu.kernels.corr_pallas import corr_lookup_pallas, pallas_available
+
+__all__ = ["corr_lookup_pallas", "pallas_available"]

@@ -1,0 +1,216 @@
+"""Pallas TPU kernel: multi-level correlation window lookup.
+
+The per-iteration hot gather of RAFT (corr.py:29-50): for every query pixel,
+fetch a (2r+1)² bilinear window from its (Hl, Wl) correlation slice at each
+pyramid level. The CUDA reference solves this with per-pixel shared-memory
+tiles (correlation_kernel.cu:19-119); XLA solves it with general gathers
+(slow on TPU) or one-hot GEMMs (corr_lookup_onehot). This kernel instead
+streams each query's integer (2r+2)² window VMEM-ward with double-buffered
+async DMA straight from the volume in HBM — reading ~P²·4 bytes per query
+instead of the whole (Hl, Wl) slice — then applies the separable 2-tap lerp
+on the VPU.
+
+Bilinear structure exploited (see ``models.corr._window_base``): all taps of
+one query share the same fractional offsets, so the kernel never does
+scatter/gather arithmetic — one strided window DMA + two lerps per query.
+
+The volume is zero-padded by PAD = 2r+3 on both spatial sides and coords are
+clamped to [-(r+2), S+r+1] beforehand, which (a) keeps every window DMA
+in-bounds without per-tap masking, and (b) preserves grid_sample's
+padding_mode='zeros' semantics exactly — windows of far-out-of-range queries
+land entirely in the zero margin.
+
+Training support: forward runs the kernel; the VJP re-expresses the lookup
+as two one-hot GEMMs (it is linear in the volume) so the backward pass is
+exact without a hand-written scatter kernel — the reference ships no usable
+CUDA backward either (its alt path calls ``.forward`` without an autograd
+wrapper, corr.py:86, so the backward kernel is dead code; SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas import is gated so CPU-only installs still work
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+
+# interpret mode runs the kernel in pure XLA — used by CPU tests
+_INTERPRET = False
+
+
+def pallas_available() -> bool:
+    if not _PALLAS_OK:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _lookup_kernel(base_ref, frac_ref, vol_ref, out_ref, scratch, sems, *,
+                   Q: int, K: int):
+    """One grid step: Q queries of one (batch, query-tile) block.
+
+    base_ref: SMEM (1, Q, 2) int32 — in-bounds window starts (x0p, y0p)
+    frac_ref: SMEM (1, Q, 2) f32 — shared bilinear fracs (wx, wy)
+    vol_ref:  ANY  (B, N, Hp, Wp) f32 — padded volume, resident in HBM
+    out_ref:  VMEM (1, Q, K²) f32
+    scratch:  VMEM (2, P, P) double buffer; sems: 2 DMA semaphores
+    """
+    P = K + 1
+    b = pl.program_id(0)
+    t = pl.program_id(1)
+
+    def window_copy(q, slot):
+        x0 = base_ref[0, q, 0]
+        y0 = base_ref[0, q, 1]
+        return pltpu.make_async_copy(
+            vol_ref.at[b, t * Q + q, pl.ds(y0, P), pl.ds(x0, P)],
+            scratch.at[slot],
+            sems.at[slot],
+        )
+
+    window_copy(0, 0).start()
+
+    def body(q, _):
+        slot = jax.lax.rem(q, 2)
+
+        @pl.when(q + 1 < Q)
+        def _():
+            window_copy(q + 1, jax.lax.rem(q + 1, 2)).start()
+
+        window_copy(q, slot).wait()
+        win = scratch[slot]                       # (P, P) [y, x]
+        wx = frac_ref[0, q, 0]
+        wy = frac_ref[0, q, 1]
+        wl = (1.0 - wy) * win[:K, :] + wy * win[1:, :]
+        w2 = (1.0 - wx) * wl[:, :K] + wx * wl[:, 1:]
+        out_ref[0, q, :] = w2.T.reshape(K * K)    # x-major channel layout
+        return 0
+
+    jax.lax.fori_loop(0, Q, body, 0, unroll=False)
+
+
+def _level_lookup_pallas(vol: jax.Array, x: jax.Array, y: jax.Array,
+                         radius: int, q_tile: int = 256) -> jax.Array:
+    """(B, N, Hl, Wl) volume + (B, N) coords -> (B, N, K²)."""
+    B, N, Hl, Wl = vol.shape
+    K = 2 * radius + 1
+    P = K + 1
+    PAD = 2 * radius + 3
+
+    # clamp far-OOB queries into the zero margin (semantics-preserving:
+    # every tap of a clamped query still reads only zeros)
+    x = jnp.clip(x, -(radius + 2.0), Wl + radius + 1.0)
+    y = jnp.clip(y, -(radius + 2.0), Hl + radius + 1.0)
+    xf = jnp.floor(x)
+    yf = jnp.floor(y)
+    base = jnp.stack(
+        [xf.astype(jnp.int32) - radius + PAD,
+         yf.astype(jnp.int32) - radius + PAD], axis=-1)      # (B, N, 2)
+    frac = jnp.stack([x - xf, y - yf], axis=-1).astype(jnp.float32)
+
+    vol_p = jnp.pad(vol, ((0, 0), (0, 0), (PAD, PAD), (PAD, PAD)))
+
+    n_pad = (-N) % q_tile
+    if n_pad:
+        base = jnp.pad(base, ((0, 0), (0, n_pad), (0, 0)))
+        frac = jnp.pad(frac, ((0, 0), (0, n_pad), (0, 0)))
+        vol_p = jnp.pad(vol_p, ((0, 0), (0, n_pad), (0, 0), (0, 0)))
+    Np = N + n_pad
+
+    kernel = functools.partial(_lookup_kernel, Q=q_tile, K=K)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, Np // q_tile),
+        in_specs=[
+            pl.BlockSpec((1, q_tile, 2), lambda b, t: (b, t, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, q_tile, 2), lambda b, t: (b, t, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, q_tile, K * K), lambda b, t: (b, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Np, K * K), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, P, P), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=_INTERPRET,
+    )(base, frac, vol_p.astype(jnp.float32))
+    return out[:, :N]
+
+
+def _lookup_fwd_impl(pyramid, x, y, radius: int):
+    outs = [_level_lookup_pallas(vol, x / (2 ** i), y / (2 ** i), radius)
+            for i, vol in enumerate(pyramid)]
+    return jnp.concatenate(outs, axis=-1)
+
+
+def _lookup_onehot_impl(pyramid, x, y, radius: int):
+    """XLA reference math for the VJP (linear in the volume)."""
+    from raft_tpu.models.corr import _separable_lerp, _window_base
+
+    P = 2 * radius + 2
+    outs = []
+    for i, vol in enumerate(pyramid):
+        Hl, Wl = vol.shape[-2:]
+        x0, y0, wx, wy = _window_base(x / (2 ** i), y / (2 ** i), radius)
+        taps = jnp.arange(P, dtype=jnp.int32)
+        sel_y = ((y0[..., None] + taps)[..., None]
+                 == jnp.arange(Hl)).astype(jnp.float32)
+        sel_x = ((x0[..., None] + taps)[..., None]
+                 == jnp.arange(Wl)).astype(jnp.float32)
+        hi = jax.lax.Precision.HIGHEST  # fp32 island, as in the forward
+        tmp = jnp.einsum("bnph,bnhw->bnpw", sel_y, vol, precision=hi)
+        win = jnp.einsum("bnpw,bnqw->bnpq", tmp, sel_x, precision=hi)
+        outs.append(_separable_lerp(win, wx, wy, radius))
+    return jnp.concatenate(outs, axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _lookup(pyramid, x, y, radius: int):
+    return _lookup_fwd_impl(pyramid, x, y, radius)
+
+
+def _lookup_fwd(pyramid, x, y, radius: int):
+    return _lookup_fwd_impl(pyramid, x, y, radius), (pyramid, x, y)
+
+
+def _lookup_bwd(radius, res, g):
+    pyramid, x, y = res
+    # exact adjoint via the one-hot formulation; coords get no gradient
+    # (the model stop-gradients the coordinate chain anyway, raft.py:123)
+    _, vjp = jax.vjp(
+        lambda vols: _lookup_onehot_impl(vols, x, y, radius), pyramid)
+    (d_pyramid,) = vjp(g)
+    return d_pyramid, None, None
+
+
+_lookup.defvjp(_lookup_fwd, _lookup_bwd)
+
+
+def corr_lookup_pallas(pyramid: Sequence[jax.Array], coords: jax.Array,
+                       radius: int) -> jax.Array:
+    """Drop-in for ``models.corr.corr_lookup`` backed by the Pallas kernel.
+
+    pyramid: list of (B, N, Hl, Wl) fp32 volumes; coords (B, H, W, 2).
+    Returns (B, H, W, levels·K²) fp32.
+    """
+    B, H, W, _ = coords.shape
+    N = H * W
+    x = coords[..., 0].reshape(B, N).astype(jnp.float32)
+    y = coords[..., 1].reshape(B, N).astype(jnp.float32)
+    out = _lookup(tuple(pyramid), x, y, radius)
+    return out.reshape(B, H, W, -1)

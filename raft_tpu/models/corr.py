@@ -123,6 +123,70 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
     return jnp.concatenate(out, axis=-1).reshape(B, H, W, -1)
 
 
+def _window_base(x: jax.Array, y: jax.Array, radius: int):
+    """Integer window base + shared bilinear fracs for a (K+1)² window.
+
+    All taps ``x+du`` share ``frac(x)`` since ``du`` is integer, so the
+    (2r+1)² bilinear lookup decomposes into an integer (2r+2)² window fetch
+    followed by a separable 2-tap lerp — the structure both the one-hot and
+    Pallas paths exploit (and exactly what the CUDA kernel's (2r+2)² iteration
+    space is, correlation_kernel.cu:56-99).
+    """
+    xf = jnp.floor(x)
+    yf = jnp.floor(y)
+    x0 = xf.astype(jnp.int32) - radius
+    y0 = yf.astype(jnp.int32) - radius
+    return x0, y0, x - xf, y - yf
+
+
+def _separable_lerp(win: jax.Array, wx: jax.Array, wy: jax.Array,
+                    radius: int) -> jax.Array:
+    """(..., K+1, K+1) [y, x] window -> (..., K²) x-major channel layout."""
+    K = 2 * radius + 1
+    wy_ = wy[..., None, None]
+    wx_ = wx[..., None, None]
+    wl = (1.0 - wy_) * win[..., :K, :] + wy_ * win[..., 1:, :]
+    out = (1.0 - wx_) * wl[..., :, :K] + wx_ * wl[..., :, 1:]
+    # [y, x] -> x-major flat (module docstring channel layout)
+    return jnp.swapaxes(out, -1, -2).reshape(*out.shape[:-2], K * K)
+
+
+def corr_lookup_onehot(pyramid: Sequence[jax.Array], coords: jax.Array,
+                       radius: int) -> jax.Array:
+    """MXU-native lookup: one-hot row/col selection instead of gathers.
+
+    Gathers are the TPU's weak spot (SURVEY.md §7 hard part #1); selecting
+    the (2r+2)² integer window with two one-hot einsums turns the lookup
+    into batched GEMMs the MXU eats (~0.2 GFLOP/level/image at 368×496),
+    and out-of-range rows/cols select nothing — zero padding for free,
+    matching grid_sample's padding_mode='zeros'.
+    """
+    B, H, W, _ = coords.shape
+    N = H * W
+    K = 2 * radius + 1
+    P = K + 1
+    x = coords[..., 0].reshape(B, N).astype(jnp.float32)
+    y = coords[..., 1].reshape(B, N).astype(jnp.float32)
+
+    out = []
+    for i, vol in enumerate(pyramid):
+        Hl, Wl = vol.shape[-2:]
+        x0, y0, wx, wy = _window_base(x / (2 ** i), y / (2 ** i), radius)
+        taps = jnp.arange(P, dtype=jnp.int32)
+        rows = y0[..., None] + taps                          # (B, N, P)
+        cols = x0[..., None] + taps
+        sel_y = (rows[..., None] == jnp.arange(Hl)).astype(jnp.float32)
+        sel_x = (cols[..., None] == jnp.arange(Wl)).astype(jnp.float32)
+        # HIGHEST: the lookup reads the fp32 corr island (raft.py:102-103);
+        # default TPU precision would round it through bf16 MXU passes
+        tmp = jnp.einsum("bnph,bnhw->bnpw", sel_y, vol,
+                         precision=HIGHEST)                  # row select
+        win = jnp.einsum("bnpw,bnqw->bnpq", tmp, sel_x,
+                         precision=HIGHEST)                  # col select
+        out.append(_separable_lerp(win, wx, wy, radius))
+    return jnp.concatenate(out, axis=-1).reshape(B, H, W, -1)
+
+
 class CorrBlock:
     """Materialized-pyramid path (corr.py:12-60)."""
 

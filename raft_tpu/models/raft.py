@@ -31,6 +31,7 @@ from raft_tpu.models.corr import (
     alt_corr_lookup,
     build_corr_pyramid,
     corr_lookup,
+    corr_lookup_onehot,
 )
 from raft_tpu.models.encoders import BasicEncoder, SmallEncoder
 from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
@@ -98,9 +99,16 @@ class RAFT(nn.Module):
         else:
             corr_state = tuple(
                 build_corr_pyramid(fmap1, fmap2, cfg.corr_levels))
+            if cfg.corr_impl == "onehot":
+                lookup_fn = corr_lookup_onehot
+            elif cfg.corr_impl == "pallas":
+                from raft_tpu.kernels import corr_lookup_pallas
+                lookup_fn = corr_lookup_pallas
+            else:
+                lookup_fn = corr_lookup
 
             def lookup(state, coords):
-                return corr_lookup(state, coords, cfg.corr_radius)
+                return lookup_fn(state, coords, cfg.corr_radius)
 
         # context network (core/raft.py:110-114)
         cnet = self.cnet(image1, train=train, use_running_average=ura)

@@ -1,0 +1,166 @@
+"""ctypes binding for the native data-plane library (``flowio.cpp``).
+
+Build happens lazily with plain ``g++`` (no pip, no pybind11 — neither is
+available in the image); failures degrade to the numpy implementations in
+``raft_tpu.data.frame_utils``, so the package works anywhere and gets the
+GIL-free fast path where a toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "flowio.cpp")
+_SO = os.path.join(_HERE, "_flowio.so")
+
+_lib = None
+_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and (os.path.getmtime(_SO)
+                                >= os.path.getmtime(_SRC)):
+        return _SO
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except Exception:
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, or None when unavailable (numpy fallback)."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        so = _build()
+        if so is None:
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            _build_failed = True
+            return None
+        i32 = ctypes.c_int32
+        p_i32 = ctypes.POINTER(i32)
+        p_f32 = ctypes.POINTER(ctypes.c_float)
+        lib.flo_header.argtypes = [ctypes.c_char_p, p_i32, p_i32]
+        lib.flo_read.argtypes = [ctypes.c_char_p, p_f32, i32, i32]
+        lib.flo_write.argtypes = [ctypes.c_char_p, p_f32, i32, i32]
+        lib.pfm_header.argtypes = [ctypes.c_char_p, p_i32, p_i32, p_i32,
+                                   p_i32, ctypes.POINTER(ctypes.c_int64)]
+        lib.pfm_read.argtypes = [ctypes.c_char_p, p_f32, i32, i32, i32, i32,
+                                 ctypes.c_int64]
+        lib.assemble_batch_u8.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), p_i32, p_i32, i32, i32, i32,
+            i32, i32, i32, p_f32, i32]
+        for fn in (lib.flo_header, lib.flo_read, lib.flo_write,
+                   lib.pfm_header, lib.pfm_read, lib.assemble_batch_u8):
+            fn.restype = i32
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def read_flo(path: str) -> Optional[np.ndarray]:
+    """Native .flo read; None on any failure (caller falls back)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
+    if lib.flo_header(path.encode(), ctypes.byref(w), ctypes.byref(h)) != 0:
+        return None
+    out = np.empty((h.value, w.value, 2), np.float32)
+    if lib.flo_read(path.encode(), _f32p(out), w, h) != 0:
+        return None
+    return out
+
+
+def write_flo(path: str, uv: np.ndarray) -> bool:
+    lib = get_lib()
+    if lib is None:
+        return False
+    uv = np.ascontiguousarray(uv, np.float32)
+    h, w = uv.shape[:2]
+    return lib.flo_write(path.encode(), _f32p(uv), w, h) == 0
+
+
+def read_pfm(path: str) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
+    ch = ctypes.c_int32()
+    le = ctypes.c_int32()
+    off = ctypes.c_int64()
+    if lib.pfm_header(path.encode(), ctypes.byref(w), ctypes.byref(h),
+                      ctypes.byref(ch), ctypes.byref(le),
+                      ctypes.byref(off)) != 0:
+        return None
+    shape = ((h.value, w.value, 3) if ch.value == 3
+             else (h.value, w.value))
+    out = np.empty(shape, np.float32)
+    if lib.pfm_read(path.encode(), _f32p(out), w, h, ch, le, off) != 0:
+        return None
+    return out
+
+
+def assemble_batch(images, offsets: np.ndarray,
+                   crop_hw: Tuple[int, int],
+                   n_threads: int = 4) -> Optional[np.ndarray]:
+    """Fused crop+cast+stack: list of HWC uint8 arrays (same shape) plus
+    per-sample (y, x) offsets -> (N, ch, cw, C) float32.
+
+    Opt-in fast path for pipelines that defer cropping to collate time
+    (the stock augmentors crop per-sample, so ``PrefetchLoader`` does not
+    route through this). Returns None on any precondition failure so
+    callers can fall back to numpy.
+    """
+    lib = get_lib()
+    if lib is None or not images:
+        return None
+    full_h, full_w, c = images[0].shape
+    imgs = [np.ascontiguousarray(im, np.uint8) for im in images]
+    if any(im.shape != (full_h, full_w, c) for im in imgs):
+        return None
+    n = len(imgs)
+    ch, cw = crop_hw
+    ys = np.ascontiguousarray(offsets[:, 0], np.int32)
+    xs = np.ascontiguousarray(offsets[:, 1], np.int32)
+    # C reads raw pointers: reject out-of-bounds crops here, like numpy would
+    if (ys.min() < 0 or xs.min() < 0 or ys.max() + ch > full_h
+            or xs.max() + cw > full_w):
+        return None
+    ptrs = (ctypes.c_void_p * n)(
+        *[im.ctypes.data_as(ctypes.c_void_p).value for im in imgs])
+    out = np.empty((n, ch, cw, c), np.float32)
+    rc = lib.assemble_batch_u8(
+        ptrs, ys.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, full_h, full_w, ch, cw, c, _f32p(out), n_threads)
+    return out if rc == 0 else None

@@ -1,0 +1,172 @@
+// Native data-plane helpers for the raft_tpu loader.
+//
+// Role: the reference's data path leans on torch DataLoader worker
+// *processes* (core/datasets.py:230-231) to hide decode/augment cost; our
+// loader uses threads (raft_tpu/data/loader.py), so the byte-moving inner
+// loops live here, outside the GIL: Middlebury .flo codec
+// (frame_utils.py:10-31,70-99 semantics), PFM decode (frame_utils.py:33-68),
+// and the batch assembler that fuses per-sample crop + uint8->float32 cast +
+// NHWC stack (the collate hot path) into one parallel pass.
+//
+// Built with plain g++ into _flowio.so; bound via ctypes (no pybind11 in
+// the image). Every entry point returns 0 on success / negative errno-style
+// codes so the Python wrapper can fall back to the numpy implementations.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr float kFloTag = 202021.25f;
+
+constexpr int kOk = 0;
+constexpr int kErrOpen = -1;
+constexpr int kErrFormat = -2;
+constexpr int kErrShort = -3;
+
+struct FileCloser {
+  FILE* f;
+  ~FileCloser() {
+    if (f) fclose(f);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Reads the (w, h) header of a .flo file. Returns kOk and fills dims.
+int flo_header(const char* path, int32_t* w, int32_t* h) {
+  FILE* f = fopen(path, "rb");
+  FileCloser closer{f};
+  if (!f) return kErrOpen;
+  float tag;
+  if (fread(&tag, 4, 1, f) != 1 || tag != kFloTag) return kErrFormat;
+  if (fread(w, 4, 1, f) != 1 || fread(h, 4, 1, f) != 1) return kErrShort;
+  if (*w <= 0 || *h <= 0 || *w > 1 << 16 || *h > 1 << 16) return kErrFormat;
+  return kOk;
+}
+
+// Reads .flo payload into out (h*w*2 floats, caller-allocated).
+int flo_read(const char* path, float* out, int32_t w, int32_t h) {
+  FILE* f = fopen(path, "rb");
+  FileCloser closer{f};
+  if (!f) return kErrOpen;
+  if (fseek(f, 12, SEEK_SET) != 0) return kErrShort;
+  size_t n = static_cast<size_t>(w) * h * 2;
+  if (fread(out, 4, n, f) != n) return kErrShort;
+  return kOk;
+}
+
+int flo_write(const char* path, const float* uv, int32_t w, int32_t h) {
+  FILE* f = fopen(path, "wb");
+  FileCloser closer{f};
+  if (!f) return kErrOpen;
+  if (fwrite(&kFloTag, 4, 1, f) != 1) return kErrShort;
+  if (fwrite(&w, 4, 1, f) != 1 || fwrite(&h, 4, 1, f) != 1) return kErrShort;
+  size_t n = static_cast<size_t>(w) * h * 2;
+  if (fwrite(uv, 4, n, f) != n) return kErrShort;
+  return kOk;
+}
+
+// Parses a PFM header; returns byte offset of the payload, fills dims,
+// channels (1 or 3) and little_endian flag.
+int pfm_header(const char* path, int32_t* w, int32_t* h, int32_t* channels,
+               int32_t* little_endian, int64_t* payload_offset) {
+  FILE* f = fopen(path, "rb");
+  FileCloser closer{f};
+  if (!f) return kErrOpen;
+  char magic[3] = {0};
+  if (fscanf(f, "%2s", magic) != 1) return kErrFormat;
+  if (strcmp(magic, "PF") == 0) {
+    *channels = 3;
+  } else if (strcmp(magic, "Pf") == 0) {
+    *channels = 1;
+  } else {
+    return kErrFormat;
+  }
+  float scale;
+  if (fscanf(f, "%d %d %f", w, h, &scale) != 3) return kErrFormat;
+  // the header ends at the first newline after the scale; tolerate CRLF
+  // (a lone fgetc would leave the '\n' in the stream and shift the
+  // payload by one byte — silently corrupt floats)
+  int ch;
+  do {
+    ch = fgetc(f);
+    if (ch == EOF) return kErrShort;
+  } while (ch != '\n');
+  if (*w <= 0 || *h <= 0) return kErrFormat;
+  *little_endian = scale < 0 ? 1 : 0;
+  *payload_offset = ftell(f);
+  return kOk;
+}
+
+// Reads PFM payload, swaps endianness if needed, flips rows (PFM stores
+// bottom-up) into out (h*w*channels floats).
+int pfm_read(const char* path, float* out, int32_t w, int32_t h,
+             int32_t channels, int32_t little_endian,
+             int64_t payload_offset) {
+  FILE* f = fopen(path, "rb");
+  FileCloser closer{f};
+  if (!f) return kErrOpen;
+  if (fseek(f, static_cast<long>(payload_offset), SEEK_SET) != 0)
+    return kErrShort;
+  size_t row = static_cast<size_t>(w) * channels;
+  std::vector<float> buf(row);
+  for (int32_t y = h - 1; y >= 0; --y) {  // flip vertically while reading
+    if (fread(buf.data(), 4, row, f) != row) return kErrShort;
+    if (!little_endian) {
+      for (size_t i = 0; i < row; ++i) {
+        uint32_t v;
+        memcpy(&v, &buf[i], 4);
+        v = __builtin_bswap32(v);
+        memcpy(&buf[i], &v, 4);
+      }
+    }
+    memcpy(out + static_cast<size_t>(y) * row, buf.data(), row * 4);
+  }
+  return kOk;
+}
+
+// Fused collate: for each sample i, crop images[i] (uint8, full_h x full_w
+// x C) at (ys[i], xs[i]) to (crop_h, crop_w) and cast to float32 into
+// out NHWC. Threads split the batch; no Python involvement.
+int assemble_batch_u8(const uint8_t** images, const int32_t* ys,
+                      const int32_t* xs, int32_t n, int32_t full_h,
+                      int32_t full_w, int32_t crop_h, int32_t crop_w,
+                      int32_t c, float* out, int32_t n_threads) {
+  if (n <= 0) return kOk;
+  size_t sample = static_cast<size_t>(crop_h) * crop_w * c;
+  auto work = [&](int32_t lo, int32_t hi) {
+    for (int32_t i = lo; i < hi; ++i) {
+      const uint8_t* src = images[i];
+      float* dst = out + static_cast<size_t>(i) * sample;
+      for (int32_t y = 0; y < crop_h; ++y) {
+        const uint8_t* row = src + (static_cast<size_t>(ys[i] + y) * full_w
+                                    + xs[i]) * c;
+        float* drow = dst + static_cast<size_t>(y) * crop_w * c;
+        for (int32_t k = 0; k < crop_w * c; ++k) {
+          drow[k] = static_cast<float>(row[k]);
+        }
+      }
+    }
+  };
+  if (n_threads <= 1 || n == 1) {
+    work(0, n);
+    return kOk;
+  }
+  std::vector<std::thread> ts;
+  int32_t per = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads && t * per < n; ++t) {
+    int32_t lo = t * per;
+    int32_t hi = lo + per < n ? lo + per : n;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+  return kOk;
+}
+
+}  // extern "C"

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _pad_amounts(ht: int, wd: int, mode: str = "sintel"):
+def pad_amounts(ht: int, wd: int, mode: str = "sintel"):
     pad_ht = (((ht // 8) + 1) * 8 - ht) % 8
     pad_wd = (((wd // 8) + 1) * 8 - wd) % 8
     if mode == "sintel":
@@ -27,7 +27,7 @@ class InputPadder:
     def __init__(self, dims, mode: str = "sintel"):
         # dims: a shape tuple (..., H, W, C) — NHWC.
         self.ht, self.wd = dims[-3], dims[-2]
-        self._pad = _pad_amounts(self.ht, self.wd, mode)
+        self._pad = pad_amounts(self.ht, self.wd, mode)
 
     def pad(self, *inputs):
         l, r, t, b = self._pad

@@ -1,0 +1,82 @@
+"""Multi-host runtime: the distributed-backend the reference never had.
+
+The reference's only parallelism is intra-process DataParallel — no process
+groups, no launcher (SURVEY.md §2 "Distributed communication backend").
+Here multi-host pods are first-class: ``initialize()`` wires the JAX
+distributed runtime (ICI within a slice, DCN across slices), and the
+global-batch helpers let each host feed only its shard while jit sees one
+global array — the SPMD replacement for both NCCL transport and launchers.
+
+Typical use (same code on every host):
+
+    from raft_tpu.parallel import distributed as dist
+    dist.initialize()                      # no-op on single host
+    mesh = make_mesh()                     # all chips across all hosts
+    batch = dist.host_local_batch(loader_batch, mesh)  # global jax.Arrays
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """``jax.distributed.initialize`` with cloud-TPU auto-detection.
+
+    Must run before anything touches a backend (so no jax.devices()/
+    process_count() probing here — that would initialize XLA and doom the
+    call). Explicit args or cluster-env presence make failures fatal;
+    otherwise a failed auto-detect means single host and is a no-op, so
+    entry points can call this unconditionally.
+    """
+    import os
+
+    explicit = (coordinator_address is not None or num_processes is not None)
+    cluster_env = any(os.environ.get(k) for k in (
+        "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS"))
+    try:
+        if explicit:
+            jax.distributed.initialize(coordinator_address, num_processes,
+                                       process_id)
+        else:
+            jax.distributed.initialize()
+    except Exception:
+        if explicit or cluster_env:
+            raise
+        return  # single host, nothing to wire
+
+
+def process_batch_slice(global_batch: int) -> slice:
+    """Which rows of the global batch this host should load."""
+    per = global_batch // jax.process_count()
+    start = jax.process_index() * per
+    return slice(start, start + per)
+
+
+def host_local_batch(batch: Dict[str, np.ndarray], mesh: Mesh
+                     ) -> Dict[str, jax.Array]:
+    """Host-local numpy shards -> global jax.Arrays on the mesh.
+
+    Each host passes the rows from ``process_batch_slice``;
+    ``make_array_from_process_local_data`` assembles the logically-global
+    batch without any host ever holding it all — the DCN-side analog of
+    the reference's per-GPU scatter (train.py:138), but across hosts.
+    """
+    out: Dict[str, jax.Array] = {}
+    for k, v in batch.items():
+        if v.ndim == 4:
+            spec = P("data", "spatial", None, None)
+        elif v.ndim == 3:
+            spec = P("data", "spatial", None)
+        else:
+            spec = P()
+        sharding = NamedSharding(mesh, spec)
+        out[k] = jax.make_array_from_process_local_data(sharding, v)
+    return out

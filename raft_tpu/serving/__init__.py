@@ -1,0 +1,12 @@
+"""Serving/export: the TPU-native analog of the reference's TensorRT path.
+
+The reference serves via ONNX -> trtexec -> a ``RAFTInferTRT`` engine
+wrapper (test_trt.py:102-161, cvt2trt.sh, raft_trt.py). Here the same roles
+are: AOT compilation (``jax.jit(...).lower().compile()``) over a shape-bucket
+envelope (``engine.py``), portable StableHLO serialization (``export.py``),
+and the video/batch helpers (``video.py`` = raft_trt_utils.py analog).
+"""
+
+from raft_tpu.serving.engine import SHAPE_ENVELOPE_LINUX, RAFTEngine
+
+__all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX"]

@@ -1,0 +1,121 @@
+"""AOT-compiled inference engine — the ``RAFTInferTRT`` analog.
+
+The reference builds a TensorRT engine over a dynamic-shape envelope
+(min/opt/max, ``cvt2trt.sh``) and binds I/O by name at runtime
+(raft_trt.py:12-39). XLA has no dynamic shapes: the envelope becomes a set
+of discrete shape buckets, each AOT-compiled once
+(``jax.jit(...).lower().compile()``), and ``infer_batch`` routes a request
+to the smallest bucket that fits, padding up (batch and spatial). That is
+the same trick TensorRT's optimization profiles play, made explicit.
+
+Like the fork's single-output ONNX export (test_trt.py:131 names only
+``flowup``), the engine's serving function returns only the upsampled flow;
+iteration count is baked at 20 (test_trt.py:124, ITERS_EXPORT).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import ITERS_EXPORT, RAFTConfig
+from raft_tpu.models import RAFT
+from raft_tpu.ops.padding import pad_amounts
+
+# cvt2trt.sh:1 envelope (min 1x3x256x256 / opt 2x3x800x800 / max 8x3x1024x1024)
+SHAPE_ENVELOPE_LINUX: List[Tuple[int, int, int]] = [
+    (1, 256, 256), (2, 800, 800), (8, 1024, 1024)]
+# cvt2trt.bat:1 envelope (max 1x3x512x1024)
+SHAPE_ENVELOPE_WINDOWS: List[Tuple[int, int, int]] = [
+    (1, 256, 256), (1, 512, 800), (1, 512, 1024)]
+
+
+class RAFTEngine:
+    """Shape-bucketed AOT engine over converted weights."""
+
+    def __init__(self, variables: Dict, config: RAFTConfig = RAFTConfig(),
+                 iters: int = ITERS_EXPORT,
+                 envelope: Sequence[Tuple[int, int, int]] = (),
+                 precompile: bool = True):
+        self.config = config
+        self.iters = iters
+        self.variables = jax.device_put(variables)
+        model = RAFT(config)
+
+        def serve(image1, image2):
+            # single-output serving fn, the exported-``flowup`` analog
+            _, flow_up = model.apply(self.variables, image1, image2,
+                                     iters=iters, test_mode=True)
+            return flow_up
+
+        self._fn = jax.jit(serve)
+        self._compiled: Dict[Tuple[int, int, int], jax.stages.Compiled] = {}
+        for shape in envelope:
+            if precompile:
+                self._get_executable(shape)
+            else:
+                self._compiled.setdefault(shape, None)
+
+    # -- shape routing ------------------------------------------------------
+
+    def _get_executable(self, shape: Tuple[int, int, int]):
+        exe = self._compiled.get(shape)
+        if exe is None:
+            b, h, w = shape
+            spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+            exe = self._fn.lower(spec, spec).compile()
+            self._compiled[shape] = exe
+        return exe
+
+    def _select_bucket(self, b: int, h: int, w: int
+                       ) -> Optional[Tuple[int, int, int]]:
+        fits = [s for s in self._compiled
+                if s[0] >= b and s[1] >= h and s[2] >= w]
+        if not fits:
+            return None
+        return min(fits, key=lambda s: s[0] * s[1] * s[2])
+
+    # -- inference ----------------------------------------------------------
+
+    def infer_batch(self, image1, image2) -> np.ndarray:
+        """(B,H,W,3) float [0,255] -> (B,H,W,2) flow. Routes to a bucket,
+        padding up (raft_trt_utils.pad_images analog); falls back to an
+        exact-shape jit specialization outside the envelope."""
+        image1 = np.asarray(image1, np.float32)
+        image2 = np.asarray(image2, np.float32)
+        b, h, w, _ = image1.shape
+        left, right, top, bottom = pad_amounts(h, w)
+        hp, wp = h + top + bottom, w + left + right
+
+        bucket = self._select_bucket(b, hp, wp)
+        if bucket is None:
+            bucket = (b, hp, wp)  # compile-on-miss, cached thereafter
+        bb, bh, bw = bucket
+        # edge-pad to stride alignment (InputPadder semantics), zero-fill the
+        # rest of the bucket
+        align = ((0, 0), (top, bottom), (left, right), (0, 0))
+        fill = ((0, bb - b), (0, bh - hp), (0, bw - wp), (0, 0))
+        i1 = jnp.asarray(np.pad(np.pad(image1, align, mode="edge"), fill))
+        i2 = jnp.asarray(np.pad(np.pad(image2, align, mode="edge"), fill))
+        flow = self._get_executable(bucket)(i1, i2)
+        return np.asarray(flow[:b, top:top + h, left:left + w, :])
+
+    def infer(self, images: Sequence[np.ndarray], batch_size: int = 4,
+              time_it: bool = False) -> List[np.ndarray]:
+        """Sliding-window flow over a frame sequence (raft_trt.py:41-67):
+        consecutive pairs, chunked into batches."""
+        flows: List[np.ndarray] = []
+        n = len(images) - 1
+        t0 = time.perf_counter()
+        for i in range(0, n, batch_size):
+            i1 = np.stack(images[i:min(i + batch_size, n)])
+            i2 = np.stack(images[i + 1:min(i + batch_size, n) + 1])
+            flows.extend(self.infer_batch(i1, i2))
+        if time_it:
+            dt = time.perf_counter() - t0
+            print(f"{n} pairs in {dt:.3f}s ({n / max(dt, 1e-9):.2f} pairs/s)")
+        return flows

@@ -1,0 +1,90 @@
+"""Portable model export via StableHLO (the ONNX-export analog).
+
+``test_trt.py:102-161`` exports a single-output graph (``flowup``) with the
+20-iteration loop baked in and dynamic batch/H/W axes. The TPU-native
+equivalent is ``jax.export``: serialize the jitted serving function to
+StableHLO bytes that any XLA runtime (TPU/CPU/GPU) can reload and run,
+with symbolic batch/spatial dims for the dynamic axes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+from raft_tpu.config import ITERS_EXPORT, RAFTConfig
+from raft_tpu.models import RAFT
+
+
+def make_serving_fn(variables: Dict, config: RAFTConfig = RAFTConfig(),
+                    iters: int = ITERS_EXPORT):
+    """Closure (image1, image2) -> flow_up with weights baked in."""
+    model = RAFT(config)
+
+    def serve(image1, image2):
+        _, flow_up = model.apply(variables, image1, image2, iters=iters,
+                                 test_mode=True)
+        return flow_up
+
+    return serve
+
+
+def export_stablehlo(variables: Dict, config: RAFTConfig = RAFTConfig(),
+                     iters: int = ITERS_EXPORT,
+                     image_hw: Tuple[int, int] = (440, 1024),
+                     dynamic_batch: bool = True) -> bytes:
+    """Serialize the serving function to portable StableHLO bytes.
+
+    Spatial dims stay static (XLA recompiles per shape; the engine's shape
+    buckets handle the envelope) while batch may be symbolic — mirroring the
+    ONNX dynamic axes declaration (test_trt.py:150-160) as far as the
+    platform allows.
+    """
+    serve = jax.jit(make_serving_fn(variables, config, iters))
+    h, w = image_hw
+    if dynamic_batch:
+        (b,) = jax_export.symbolic_shape("b")
+        spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+    else:
+        spec = jax.ShapeDtypeStruct((1, h, w, 3), jnp.float32)
+    exported = jax_export.export(serve)(spec, spec)
+    return bytes(exported.serialize())  # serialize() may hand back bytearray
+
+
+def load_stablehlo(blob: bytes):
+    """Deserialize and return a callable (image1, image2) -> flow_up."""
+    exported = jax_export.deserialize(blob)
+    return lambda i1, i2: exported.call(i1, i2)
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Export RAFT to portable StableHLO")
+    p.add_argument("--model", required=True, help=".pth or .msgpack weights")
+    p.add_argument("--out", required=True, help="output .stablehlo path")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--iters", type=int, default=ITERS_EXPORT)
+    p.add_argument("--height", type=int, default=440)
+    p.add_argument("--width", type=int, default=1024)
+    p.add_argument("--static_batch", action="store_true")
+    args = p.parse_args(argv)
+
+    from raft_tpu.training.trainer import load_weights
+
+    cfg = RAFTConfig(small=args.small)
+    variables = load_weights(args.model, cfg)
+    blob = export_stablehlo(variables, cfg, args.iters,
+                            (args.height, args.width),
+                            dynamic_batch=not args.static_batch)
+    with open(args.out, "wb") as f:
+        f.write(blob)
+    print(f"exported {len(blob)} bytes -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Batch pad/unpad + multi-flow video visualization.
+
+The ``raft_trt_utils.py`` analog: functional stride-8 padding for engine
+inputs (raft_trt_utils.py:8-21 — provided by ``raft_tpu.ops.padding``) and
+the multi-flow AVI writer (raft_trt_utils.py:24-51). Keeps the fork's fixed
+normalization radius so colors stay consistent across frames
+(core/utils/flow_viz.py:128-130).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from raft_tpu.utils.flow_viz import flow_to_image
+
+
+def optical_flow_visualize(flows: Sequence[np.ndarray],
+                           output: str = "flow.avi",
+                           fps: float = 30.0,
+                           images: Optional[Sequence[np.ndarray]] = None
+                           ) -> str:
+    """Render flows (each (H, W, 2)) to an AVI; optionally stack each frame
+    above its flow like the reference's side-by-side viz."""
+    import cv2
+
+    assert len(flows) > 0
+    frames = []
+    for i, flow in enumerate(flows):
+        flo = flow_to_image(np.asarray(flow))
+        if images is not None:
+            img = np.asarray(images[i]).astype(np.uint8)
+            flo = np.concatenate([img, flo], axis=0)
+        frames.append(cv2.cvtColor(flo, cv2.COLOR_RGB2BGR))
+
+    h, w = frames[0].shape[:2]
+    writer = cv2.VideoWriter(output, cv2.VideoWriter_fourcc(*"MJPG"), fps,
+                             (w, h))
+    try:
+        for f in frames:
+            writer.write(f)
+    finally:
+        writer.release()
+    return output

@@ -1,0 +1,72 @@
+"""Training logger: running means -> stdout + TensorBoard.
+
+Replicates the reference ``Logger`` (train.py:89-133): running means of the
+step metrics printed every ``sum_freq`` steps together with the step count
+and current LR, scalars written to TensorBoard under the same names
+(epe/1px/3px/5px/loss), and validation dicts written at eval points — the
+metric names stay identical so dashboards remain comparable (SURVEY.md §5).
+
+TensorBoard is optional: when unavailable, scalars also land in a JSONL file
+next to the event log so headless runs stay observable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, Optional
+
+
+class Logger:
+    def __init__(self, log_dir: str = "runs", sum_freq: int = 100,
+                 lr_fn: Optional[Callable[[int], float]] = None):
+        self.sum_freq = sum_freq
+        self.lr_fn = lr_fn
+        self.total_steps = 0
+        self.running: Dict[str, float] = {}
+        self._t0 = time.perf_counter()
+        self._last_steps = 0
+        os.makedirs(log_dir, exist_ok=True)
+        self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self.writer = SummaryWriter(log_dir)
+        except Exception:
+            self.writer = None
+
+    def _print_status(self):
+        lr = float(self.lr_fn(self.total_steps)) if self.lr_fn else 0.0
+        dt = time.perf_counter() - self._t0
+        ips = (self.total_steps - self._last_steps) / max(dt, 1e-9)
+        self._t0, self._last_steps = time.perf_counter(), self.total_steps
+        # training status, mirroring train.py:97-103's fixed-width line
+        keys = sorted(self.running)
+        metrics_str = ("".join(
+            f"{self.running[k] / self.sum_freq:10.4f}, " for k in keys))
+        print(f"[{self.total_steps + 1:6d}, {lr:10.7f}] {metrics_str}"
+              f"({ips:.2f} steps/s)", flush=True)
+
+    def push(self, metrics: Dict[str, float]):
+        self.total_steps += 1
+        for k, v in metrics.items():
+            self.running[k] = self.running.get(k, 0.0) + float(v)
+        if self.total_steps % self.sum_freq == self.sum_freq - 1:
+            self._print_status()
+            self.write_dict(
+                {k: v / self.sum_freq for k, v in self.running.items()})
+            self.running = {}
+
+    def write_dict(self, results: Dict[str, float]):
+        rec = {"step": self.total_steps}
+        for k, v in results.items():
+            rec[k] = float(v)
+            if self.writer is not None:
+                self.writer.add_scalar(k, float(v), self.total_steps)
+        self._jsonl.write(json.dumps(rec) + "\n")
+        self._jsonl.flush()
+
+    def close(self):
+        if self.writer is not None:
+            self.writer.close()
+        self._jsonl.close()

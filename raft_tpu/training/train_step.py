@@ -95,10 +95,13 @@ def make_train_step(model_cfg: RAFTConfig, train_cfg: TrainConfig):
             variables = {"params": params}
             if has_bn:
                 variables["batch_stats"] = state.batch_stats
+            kwargs = dict(
+                rngs={"dropout": rng} if model_cfg.dropout > 0 else {})
+            if mutable:  # flax returns a 2-tuple whenever mutable is passed
+                kwargs["mutable"] = mutable
             out = model.apply(
                 variables, image1, image2, iters=train_cfg.iters,
-                train=True, freeze_bn=freeze_bn, mutable=mutable,
-                rngs={"dropout": rng} if model_cfg.dropout > 0 else {},
+                train=True, freeze_bn=freeze_bn, **kwargs,
             )
             if mutable:
                 preds, updated = out

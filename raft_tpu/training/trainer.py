@@ -1,0 +1,191 @@
+"""The training loop: SPMD train step + data pipeline + logging + ckpt.
+
+The ``train(args)`` analog (train.py:136-214), TPU-first:
+
+- one jit-compiled step over a device ``Mesh`` (batch sharded on the data
+  axis, params replicated) instead of ``nn.DataParallel`` (train.py:138);
+  XLA inserts the gradient psum over ICI;
+- bf16 mixed precision by policy — no GradScaler, fp32 islands live inside
+  the model (core/raft.py:102-103 analog);
+- full-train-state Orbax checkpoints every ``val_freq`` steps plus
+  weights-only msgpack finals mirroring ``checkpoints/<name>.pth``
+  (train.py:185-187, 211-212);
+- validation every ``val_freq`` with the reference metric names
+  (train.py:189-198).
+
+Restore semantics: ``restore_ckpt`` loads weights only with the reference's
+``strict=False`` spirit (train.py:141-142) — the LR schedule restarts, which
+the curriculum depends on; ``resume=True`` restores the FULL state (the
+capability upgrade).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from raft_tpu.config import RAFTConfig, TrainConfig
+from raft_tpu.parallel.mesh import make_mesh, replicated, shard_batch
+from raft_tpu.training import checkpoint as ckpt_lib
+from raft_tpu.training.logger import Logger
+from raft_tpu.training.optim import onecycle_linear_schedule
+from raft_tpu.training.train_step import (RAFTTrainState, create_train_state,
+                                          make_train_step)
+
+
+def load_weights(path: str, config: RAFTConfig) -> Dict:
+    """Load weights from a reference ``.pth`` or converted msgpack."""
+    from raft_tpu.tools import convert
+
+    if path.endswith(".pth"):
+        return convert.load_pth(path, config)
+    return convert.load_converted(path, config)
+
+
+def run_validation(variables, model_cfg: RAFTConfig, names,
+                   data_root: str) -> Dict[str, float]:
+    from raft_tpu.evaluation import evaluate as ev
+
+    results: Dict[str, float] = {}
+    for name in names:
+        try:
+            if name == "chairs":
+                results.update(ev.validate_chairs(
+                    variables, model_cfg, data_root=data_root))
+            elif name == "sintel":
+                results.update(ev.validate_sintel(
+                    variables, model_cfg, data_root=data_root))
+            elif name == "kitti":
+                results.update(ev.validate_kitti(
+                    variables, model_cfg, data_root=data_root))
+        except FileNotFoundError as e:
+            print(f"validation '{name}' skipped: {e}", flush=True)
+    return results
+
+
+def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
+          resume: bool = False, loader=None) -> RAFTTrainState:
+    """Run one curriculum stage; returns the final state."""
+    np.random.seed(train_cfg.seed)  # train.py:241-242
+    rng = jax.random.PRNGKey(train_cfg.seed)
+
+    stage_dir = os.path.join(train_cfg.checkpoint_dir, train_cfg.name,
+                             train_cfg.stage)
+    os.makedirs(stage_dir, exist_ok=True)
+
+    init_variables = None
+    if train_cfg.restore_ckpt:
+        init_variables = load_weights(train_cfg.restore_ckpt, model_cfg)
+    state = create_train_state(model_cfg, train_cfg, rng,
+                               image_hw=train_cfg.image_size,
+                               init_variables=init_variables)
+    if resume and ckpt_lib.latest_step(stage_dir) is not None:
+        state = ckpt_lib.restore_train_state(stage_dir, state)
+        print(f"resumed from step {int(state.step)}", flush=True)
+
+    if loader is None:
+        from raft_tpu.data.loader import fetch_dataloader
+        loader = fetch_dataloader(
+            train_cfg.stage, train_cfg.image_size, train_cfg.batch_size,
+            data_root=train_cfg.data_root, num_workers=train_cfg.num_workers,
+            seed=train_cfg.seed)
+
+    mesh = make_mesh()
+    step_fn = jax.jit(make_train_step(model_cfg, train_cfg),
+                      donate_argnums=(0,))
+    schedule = onecycle_linear_schedule(train_cfg.lr, train_cfg.num_steps + 100)
+    logger = Logger(os.path.join(train_cfg.log_dir, train_cfg.name),
+                    train_cfg.sum_freq, lr_fn=schedule)
+    logger.total_steps = int(state.step)
+
+    with mesh:
+        state = jax.device_put(state, replicated(mesh))
+        total_steps = int(state.step)
+        keep_training = total_steps < train_cfg.num_steps
+        prof = train_cfg.profile_steps
+        profiling = False
+        pending_metrics = None  # one step in flight: keep dispatch async
+
+        def drain_metrics():
+            nonlocal pending_metrics
+            if pending_metrics is not None:
+                logger.push({k: float(v) for k, v in pending_metrics.items()
+                             if k in ("loss", "epe", "1px", "3px", "5px")})
+                pending_metrics = None
+
+        while keep_training:
+            for batch in loader:
+                if (prof and not profiling
+                        and prof[0] <= total_steps < prof[1]):
+                    jax.profiler.start_trace(
+                        os.path.join(train_cfg.log_dir, train_cfg.name))
+                    profiling = True
+                rng, step_rng = jax.random.split(rng)
+                sharded = shard_batch(batch, mesh)
+                state, metrics = step_fn(state, sharded, step_rng)
+                if profiling and total_steps >= prof[1]:
+                    jax.block_until_ready(metrics)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                # materialize the PREVIOUS step's metrics after dispatching
+                # this one, so the host never serializes with the device
+                drain_metrics()
+                pending_metrics = metrics
+                total_steps += 1
+
+                if total_steps % train_cfg.val_freq == train_cfg.val_freq - 1:
+                    ckpt_lib.save_train_state(stage_dir, state)
+                    # <step+1>_<name>.pth analog (train.py:185-187)
+                    weights_path = os.path.join(
+                        train_cfg.checkpoint_dir,
+                        f"{total_steps + 1}_{train_cfg.name}.msgpack")
+                    ckpt_lib.save_weights(
+                        weights_path,
+                        jax.device_get(
+                            ckpt_lib.variables_from_state(state)))
+                    results = run_validation(
+                        ckpt_lib.variables_from_state(state), model_cfg,
+                        train_cfg.validation, train_cfg.data_root)
+                    if results:
+                        logger.write_dict(results)
+
+                if total_steps >= train_cfg.num_steps:
+                    keep_training = False
+                    break
+        drain_metrics()
+        if profiling:
+            jax.block_until_ready(state.params)
+            jax.profiler.stop_trace()
+
+    final_path = os.path.join(train_cfg.checkpoint_dir,
+                              f"{train_cfg.name}.msgpack")
+    ckpt_lib.save_weights(
+        final_path,
+        jax.device_get(ckpt_lib.variables_from_state(state)))
+    print(f"saved final weights to {final_path}", flush=True)
+    ckpt_lib.close_all()  # flush pending async Orbax saves
+    logger.close()
+    return state
+
+
+def train_curriculum(stages, model_cfg: RAFTConfig, name: str = "raft",
+                     mixed: bool = False, **overrides) -> None:
+    """`train_standard.sh` / `train_mixed.sh` analog: chain stages, each
+    restoring the previous stage's final weights with a fresh schedule
+    (train_standard.sh:4-6)."""
+    from raft_tpu.config import stage_config
+
+    prev_final: Optional[str] = None
+    for stage in stages:
+        cfg = stage_config(stage, mixed=mixed, name=f"{name}-{stage}",
+                           restore_ckpt=prev_final, **overrides)
+        t0 = time.perf_counter()
+        train(model_cfg, cfg)
+        print(f"stage {stage} done in {time.perf_counter() - t0:.0f}s",
+              flush=True)
+        prev_final = os.path.join(cfg.checkpoint_dir,
+                                  f"{cfg.name}.msgpack")

@@ -6,7 +6,10 @@ device-count emulation, per the driver contract. Must run before jax imports.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu: the environment pins JAX_PLATFORMS=axon (the remote TPU
+# tunnel), which would serialize every test through the one real chip's
+# remote compiler. Tests run on the virtual 8-device CPU mesh by contract.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,7 +18,25 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# The image's sitecustomize registers the 'axon' remote-TPU PJRT plugin in
+# every interpreter, and jax initializes it even under JAX_PLATFORMS=cpu —
+# each test process would then dial (and block on) the single TPU tunnel.
+# Deregister the factory before any backend is initialized: tests are
+# CPU-mesh only by contract.
+try:  # noqa: SIM105
+    from jax._src import xla_bridge as _xb
+
+    for _reg in ("_backend_factories",):
+        getattr(_xb, _reg, {}).pop("axon", None)
+except Exception:
+    pass
+
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", "/tmp/raft_tpu_jax_cache")
+# Golden-parity tests compare against torch fp32 oracles; this XLA CPU build
+# lowers conv/dot to a reduced-precision path by default (observed ~1e-1 abs
+# drift vs torch on a 3x3 conv), so force true fp32 accumulation under test.
+jax.config.update("jax_default_matmul_precision", "highest")
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 

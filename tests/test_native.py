@@ -1,0 +1,94 @@
+"""Native codec parity: C++ flowio vs the numpy reference implementations.
+
+Round-trips every format through both paths; skips cleanly when no
+toolchain is available (the package must work without it).
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu import native
+from raft_tpu.data import frame_utils
+
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def _numpy_read_flow(path):
+    with open(path, "rb") as f:
+        magic = np.fromfile(f, np.float32, count=1)
+        assert magic[0] == np.float32(frame_utils.TAG_FLO)
+        w = int(np.fromfile(f, np.int32, count=1)[0])
+        h = int(np.fromfile(f, np.int32, count=1)[0])
+        return np.fromfile(f, np.float32, count=2 * w * h).reshape(h, w, 2)
+
+
+class TestFlo:
+    def test_roundtrip(self, tmp_path, rng):
+        uv = rng.randn(17, 23, 2).astype(np.float32)
+        p = str(tmp_path / "a.flo")
+        assert native.write_flo(p, uv)
+        np.testing.assert_array_equal(native.read_flo(p), uv)
+        # byte-identical to what the numpy reader sees
+        np.testing.assert_array_equal(_numpy_read_flow(p), uv)
+
+    def test_frame_utils_uses_native(self, tmp_path, rng):
+        uv = rng.randn(5, 7, 2).astype(np.float32)
+        p = str(tmp_path / "b.flo")
+        frame_utils.write_flow(p, uv)
+        np.testing.assert_array_equal(frame_utils.read_flow(p), uv)
+
+    def test_bad_file_returns_none(self, tmp_path):
+        p = tmp_path / "bad.flo"
+        p.write_bytes(b"not a flo file")
+        assert native.read_flo(str(p)) is None
+
+
+class TestPfm:
+    @pytest.mark.parametrize("color", [False, True])
+    def test_matches_numpy_reader(self, tmp_path, rng, color):
+        shape = (11, 13, 3) if color else (11, 13)
+        data = rng.randn(*shape).astype(np.float32)
+        p = str(tmp_path / "x.pfm")
+        frame_utils.write_pfm(p, data)
+        got = native.read_pfm(p)
+        np.testing.assert_array_equal(got, data)
+
+
+class TestAssembleBatch:
+    def test_matches_numpy_crop_stack(self, rng):
+        images = [rng.randint(0, 255, (20, 30, 3), dtype=np.uint8)
+                  for _ in range(5)]
+        offs = np.stack([rng.randint(0, 10, 5), rng.randint(0, 14, 5)], -1)
+        got = native.assemble_batch(images, offs, (8, 12), n_threads=3)
+        want = np.stack([
+            images[i][offs[i, 0]:offs[i, 0] + 8,
+                      offs[i, 1]:offs[i, 1] + 12].astype(np.float32)
+            for i in range(5)])
+        np.testing.assert_array_equal(got, want)
+
+    def test_shape_mismatch_falls_back(self, rng):
+        images = [np.zeros((4, 4, 3), np.uint8), np.zeros((5, 4, 3), np.uint8)]
+        assert native.assemble_batch(images, np.zeros((2, 2), np.int32),
+                                     (2, 2)) is None
+
+    def test_out_of_bounds_crop_rejected(self):
+        images = [np.zeros((4, 4, 3), np.uint8)]
+        offs = np.array([[3, 0]], np.int32)  # 3 + crop 2 > 4
+        assert native.assemble_batch(images, offs, (2, 2)) is None
+        offs = np.array([[-1, 0]], np.int32)
+        assert native.assemble_batch(images, offs, (2, 2)) is None
+
+
+class TestPfmCRLF:
+    def test_crlf_header_matches_numpy(self, tmp_path, rng):
+        """Windows-written PFM: header lines end in \\r\\n; the payload must
+        not shift by a byte."""
+        data = rng.randn(6, 5).astype(np.float32)
+        p = tmp_path / "crlf.pfm"
+        with open(p, "wb") as f:
+            f.write(b"Pf\r\n5 6\r\n-1.0\r\n")
+            np.flipud(data).astype("<f").tofile(f)
+        got = native.read_pfm(str(p))
+        np.testing.assert_array_equal(got, data)

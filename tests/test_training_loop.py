@@ -1,0 +1,118 @@
+"""End-to-end trainer loop, checkpoint/resume, and logger tests (CPU mesh).
+
+The reference has no trainer tests (SURVEY.md §4); these pin the loop's
+contract: steps advance, loss is finite, full-state resume restores the
+optimizer/step exactly, and metric names match the reference dashboards.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu.config import RAFTConfig, TrainConfig
+from raft_tpu.training import checkpoint as ckpt_lib
+from raft_tpu.training.logger import Logger
+from raft_tpu.training.train_step import create_train_state
+from raft_tpu.training.trainer import train
+
+
+class SyntheticLoader:
+    """Tiny deterministic batch source (stands in for PrefetchLoader)."""
+
+    def __init__(self, batch_size=8, hw=(64, 64), n_batches=2, seed=0):
+        rng = np.random.RandomState(seed)
+        h, w = hw
+        self.batches = [{
+            "image1": rng.rand(batch_size, h, w, 3).astype(np.float32) * 255,
+            "image2": rng.rand(batch_size, h, w, 3).astype(np.float32) * 255,
+            "flow": rng.randn(batch_size, h, w, 2).astype(np.float32),
+            "valid": np.ones((batch_size, h, w), np.float32),
+        } for _ in range(n_batches)]
+
+    def __iter__(self):
+        return iter(self.batches)
+
+
+@pytest.fixture(scope="module")
+def small_cfg():
+    return RAFTConfig(small=True)
+
+
+def make_train_cfg(tmpdir, **kw):
+    base = dict(name="t", stage="chairs", lr=1e-4, num_steps=3, batch_size=8,
+                image_size=(64, 64), iters=2, val_freq=10 ** 9,
+                sum_freq=2, checkpoint_dir=os.path.join(tmpdir, "ckpt"),
+                log_dir=os.path.join(tmpdir, "runs"))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+class TestTrainLoop:
+    def test_runs_and_saves_final(self, tmp_path, small_cfg):
+        cfg = make_train_cfg(str(tmp_path))
+        state = train(small_cfg, cfg, loader=SyntheticLoader())
+        assert int(state.step) == 3
+        final = os.path.join(cfg.checkpoint_dir, "t.msgpack")
+        assert os.path.exists(final)
+        # weights reloadable through the standard path
+        from raft_tpu.tools.convert import load_converted
+        variables = load_converted(final, small_cfg, image_hw=(64, 64))
+        assert "params" in variables
+
+    def test_add_noise_and_metrics_finite(self, tmp_path, small_cfg):
+        cfg = make_train_cfg(str(tmp_path), add_noise=True, num_steps=2)
+        state = train(small_cfg, cfg, loader=SyntheticLoader())
+        assert int(state.step) == 2
+        leaves = jax.tree.leaves(state.params)
+        assert all(bool(np.isfinite(np.asarray(x)).all()) for x in leaves)
+
+
+class TestCheckpointResume:
+    def test_full_state_roundtrip(self, tmp_path, small_cfg):
+        tcfg = make_train_cfg(str(tmp_path), num_steps=2)
+        state = train(small_cfg, tcfg, loader=SyntheticLoader())
+        stage_dir = os.path.join(tcfg.checkpoint_dir, "t", "chairs")
+        ckpt_lib.save_train_state(stage_dir, state, wait=True)
+
+        fresh = create_train_state(small_cfg, tcfg, jax.random.PRNGKey(1),
+                                   image_hw=(64, 64))
+        restored = ckpt_lib.restore_train_state(stage_dir, fresh)
+        assert int(restored.step) == int(state.step)
+        for a, b in zip(jax.tree.leaves(restored.params),
+                        jax.tree.leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # optimizer state (adam moments) restored too — the upgrade the
+        # reference lacks (train.py:185-187 saves weights only)
+        for a, b in zip(jax.tree.leaves(restored.opt_state),
+                        jax.tree.leaves(state.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_latest_step_empty(self, tmp_path):
+        assert ckpt_lib.latest_step(str(tmp_path / "nope")) is None
+
+
+class TestLogger:
+    def test_running_mean_and_jsonl(self, tmp_path, capsys):
+        log_dir = str(tmp_path / "runs")
+        logger = Logger(log_dir, sum_freq=2, lr_fn=lambda s: 1e-4)
+        # reference quirk preserved (train.py:119-123): the window closes
+        # when total_steps % freq == freq-1, so the FIRST window holds
+        # freq-1 pushes but still divides by freq
+        logger.push({"epe": 2.0, "loss": 1.0})   # closes window 1
+        logger.push({"epe": 4.0, "loss": 3.0})
+        logger.push({"epe": 6.0, "loss": 5.0})   # closes window 2
+        logger.write_dict({"chairs": 5.0})
+        logger.close()
+        out = capsys.readouterr().out
+        assert "0.0001" in out  # lr printed
+        recs = [json.loads(l) for l in
+                open(os.path.join(log_dir, "metrics.jsonl"))]
+        assert recs[0]["epe"] == pytest.approx(1.0)  # 2.0 / freq
+        assert recs[1]["epe"] == pytest.approx(5.0)  # (4+6) / freq
+        assert recs[-1]["chairs"] == 5.0
+        assert glob.glob(os.path.join(log_dir, "events.*"))  # tensorboard
