@@ -34,6 +34,11 @@ class RAFTConfig:
     # take), 'onehot' (MXU one-hot GEMMs), or 'pallas' (window-DMA kernel,
     # TPU only). Benchmark with `python -m raft_tpu.cli.corr_bench`.
     corr_impl: str = "gather"
+    # rematerialize the refinement-iteration body in the backward pass:
+    # trades ~30% recompute for dropping the per-iteration activation stack
+    # (observed ~1.5 GB/buffer at chairs shapes), the jax.checkpoint lever
+    # HBM-bound training wants (SURVEY.md §7 "HBM bandwidth")
+    remat: bool = False
 
     @property
     def hidden_dim(self) -> int:

@@ -136,8 +136,9 @@ class RAFT(nn.Module):
                 flow_up = convex_upsample(new_flow, up_mask)
             return (net, coords1), flow_up
 
+        body = nn.remat(_iteration) if cfg.remat else _iteration
         scan = nn.scan(
-            _iteration,
+            body,
             variable_broadcast="params",
             split_rngs={"params": False, "dropout": False},
             in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),

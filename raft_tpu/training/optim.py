@@ -22,14 +22,18 @@ def onecycle_linear_schedule(peak_lr: float, total_steps: int,
     """
     initial = peak_lr / div_factor
     final = initial / final_div_factor
-    warm = float(max(1, round(pct_start * total_steps)))
+    # torch's phase boundaries: warm-up ends at pct_start*total - 1 and the
+    # anneal reaches `final` exactly at step total - 1 (lr_scheduler.py's
+    # _schedule_phases) — the off-by-ones matter for short schedules
+    warm_end = max(pct_start * total_steps - 1.0, 1.0)
+    down_len = max(total_steps - 1.0 - warm_end, 1.0)
 
     def schedule(step):
         step = jnp.asarray(step, jnp.float32)
-        up = initial + (peak_lr - initial) * (step / warm)
-        frac = (step - warm) / max(total_steps - warm, 1.0)
+        up = initial + (peak_lr - initial) * (step / warm_end)
+        frac = jnp.clip((step - warm_end) / down_len, 0.0, 1.0)
         down = peak_lr + (final - peak_lr) * frac
-        return jnp.where(step < warm, up, jnp.minimum(down, peak_lr))
+        return jnp.where(step <= warm_end, jnp.minimum(up, peak_lr), down)
 
     return schedule
 

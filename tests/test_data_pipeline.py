@@ -1,0 +1,254 @@
+"""Data-pipeline tests: augmentors, dataset indexers on synthetic trees,
+loader determinism, flow visualization — the coverage gap SURVEY.md §4
+calls out (the reference ships zero tests for its data path)."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from raft_tpu.data import frame_utils
+from raft_tpu.data.augmentor import (FlowAugmentor, SparseFlowAugmentor,
+                                     adjust_brightness, adjust_contrast)
+from raft_tpu.data.datasets import FlyingChairs, KITTI, MpiSintel
+from raft_tpu.data.loader import PrefetchLoader
+from raft_tpu.utils.flow_viz import flow_to_image, make_colorwheel
+
+
+class ScriptedRNG:
+    """Stands in for RandomState: scripted uniform/rand draws, real ints."""
+
+    def __init__(self, rand_values, uniform_value=0.0, base=None):
+        self._rand = list(rand_values)
+        self._uniform = uniform_value
+        self._base = base or np.random.RandomState(0)
+
+    def rand(self, *a):
+        if a:
+            return self._base.rand(*a)
+        return self._rand.pop(0) if self._rand else 1.0
+
+    def uniform(self, lo, hi, *a, **k):
+        return self._uniform
+
+    def randint(self, lo, hi=None, *a, **k):
+        return lo  # deterministic: crop at origin, smallest rectangles
+
+    def permutation(self, n):
+        return self._base.permutation(n)
+
+
+class TestFlowAugmentor:
+    def test_output_shapes_and_contiguity(self, rng):
+        aug = FlowAugmentor(crop_size=(48, 64), do_flip=True,
+                            rng=np.random.RandomState(3))
+        img1 = rng.randint(0, 255, (80, 100, 3)).astype(np.uint8)
+        img2 = rng.randint(0, 255, (80, 100, 3)).astype(np.uint8)
+        flow = rng.randn(80, 100, 2).astype(np.float32)
+        o1, o2, of = aug(img1, img2, flow)
+        assert o1.shape == (48, 64, 3) and o2.shape == (48, 64, 3)
+        assert of.shape == (48, 64, 2)
+        assert o1.flags.c_contiguous and of.flags.c_contiguous
+
+    def test_hflip_negates_u(self, rng):
+        """h-flip: u component negated, v kept (augmentor.py:97-100)."""
+        aug = FlowAugmentor(crop_size=(8, 8), do_flip=True)
+        # scripted rand() draws, in call order: asymmetric-color off,
+        # eraser off, stretch off, spatial-aug off, h-flip ON, v-flip off
+        aug.rng = ScriptedRNG([1.0, 1.0, 1.0, 1.0, 0.0, 1.0])
+        aug.photo_aug = lambda img, rng: img  # disable color jitter
+        img = np.zeros((8, 8, 3), np.uint8)
+        flow = np.stack(np.meshgrid(np.arange(8), np.arange(8)),
+                        -1).astype(np.float32)
+        _, _, of = aug(img.copy(), img.copy(), flow)
+        np.testing.assert_array_equal(of[..., 0], -flow[:, ::-1, 0])
+        np.testing.assert_array_equal(of[..., 1], flow[:, ::-1, 1])
+
+    def test_scale_multiplies_flow(self, rng):
+        """2x resize doubles displacement vectors (augmentor.py:83-88)."""
+        aug = FlowAugmentor(crop_size=(16, 16), min_scale=1.0, max_scale=1.0,
+                            do_flip=False)
+        # draws: asym-color off, eraser off, stretch off, spatial aug ON;
+        # uniform -> scale exponent 1.0 => 2x resize
+        aug.rng = ScriptedRNG([1.0, 1.0, 1.0, 0.0], uniform_value=1.0)
+        aug.photo_aug = lambda img, rng: img
+        img = rng.randint(0, 255, (16, 16, 3)).astype(np.uint8)
+        flow = np.full((16, 16, 2), 1.5, np.float32)
+        _, _, of = aug(img.copy(), img.copy(), flow)
+        np.testing.assert_allclose(of, 3.0, rtol=1e-5)
+
+    def test_determinism_via_reseed(self, rng):
+        img1 = rng.randint(0, 255, (64, 80, 3)).astype(np.uint8)
+        img2 = rng.randint(0, 255, (64, 80, 3)).astype(np.uint8)
+        flow = rng.randn(64, 80, 2).astype(np.float32)
+        outs = []
+        for _ in range(2):
+            aug = FlowAugmentor(crop_size=(32, 40), do_flip=True)
+            aug.reseed(77)
+            outs.append(aug(img1.copy(), img2.copy(), flow.copy()))
+        for a, b in zip(outs[0], outs[1]):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestColorOps:
+    def test_brightness_zero_blacks_out(self, rng):
+        img = rng.randint(0, 255, (4, 4, 3)).astype(np.uint8)
+        assert adjust_brightness(img, 0.0).max() == 0
+        np.testing.assert_array_equal(adjust_brightness(img, 1.0), img)
+
+    def test_contrast_one_is_identity(self, rng):
+        img = rng.randint(0, 255, (4, 4, 3)).astype(np.uint8)
+        np.testing.assert_array_equal(adjust_contrast(img, 1.0), img)
+
+
+class TestSparseAugmentor:
+    def test_sparse_rescale_scatter(self):
+        """Valid points land at rounded scaled coords with scaled flow
+        (augmentor.py:161-193 semantics)."""
+        aug = SparseFlowAugmentor(crop_size=(4, 4))
+        flow = np.zeros((4, 6, 2), np.float32)
+        valid = np.zeros((4, 6), np.float32)
+        flow[2, 3] = [1.0, -2.0]
+        valid[2, 3] = 1.0
+        out_flow, out_valid = aug.resize_sparse_flow_map(flow, valid,
+                                                         fx=2.0, fy=2.0)
+        assert out_flow.shape == (8, 12, 2)
+        assert out_valid[4, 6] == 1
+        np.testing.assert_allclose(out_flow[4, 6], [2.0, -4.0])
+        assert out_valid.sum() == 1  # nothing else scattered
+
+
+def make_sintel_tree(root, n=3, hw=(32, 48)):
+    h, w = hw
+    rng = np.random.RandomState(0)
+    img_dir = os.path.join(root, "Sintel/training/clean/alley_1")
+    flow_dir = os.path.join(root, "Sintel/training/flow/alley_1")
+    os.makedirs(img_dir)
+    os.makedirs(flow_dir)
+    for i in range(n):
+        Image.fromarray(rng.randint(0, 255, (h, w, 3)).astype(np.uint8)
+                        ).save(os.path.join(img_dir, f"frame_{i:04d}.png"))
+        if i < n - 1:
+            frame_utils.write_flow(
+                os.path.join(flow_dir, f"frame_{i:04d}.flo"),
+                rng.randn(h, w, 2).astype(np.float32))
+
+
+class TestDatasetsOnSyntheticTrees:
+    def test_sintel_training(self, tmp_path):
+        make_sintel_tree(str(tmp_path))
+        ds = MpiSintel(aug_params=None, split="training",
+                       root=str(tmp_path / "Sintel"), dstype="clean")
+        assert len(ds) == 2
+        img1, img2, flow, valid = ds[0]
+        assert img1.shape == (32, 48, 3) and flow.shape == (32, 48, 2)
+        assert valid.min() == 1.0  # all synthetic flows < 1000
+
+    def test_sintel_test_mode(self, tmp_path):
+        h, w = 32, 48
+        rng = np.random.RandomState(0)
+        img_dir = tmp_path / "Sintel/test/clean/seq_1"
+        os.makedirs(img_dir)
+        for i in range(3):
+            Image.fromarray(rng.randint(0, 255, (h, w, 3)).astype(np.uint8)
+                            ).save(str(img_dir / f"frame_{i:04d}.png"))
+        ds = MpiSintel(split="test", root=str(tmp_path / "Sintel"),
+                       dstype="clean")
+        img1, img2, (seq, frame) = ds[0]
+        assert seq == "seq_1" and frame == 0
+        assert img1.dtype == np.float32
+
+    def test_chairs_split(self, tmp_path):
+        rng = np.random.RandomState(0)
+        data = tmp_path / "FlyingChairs_release/data"
+        os.makedirs(data)
+        for i in range(1, 4):
+            for k in (1, 2):
+                Image.fromarray(
+                    rng.randint(0, 255, (16, 24, 3)).astype(np.uint8)
+                ).save(str(data / f"{i:05d}_img{k}.ppm"))
+            frame_utils.write_flow(str(data / f"{i:05d}_flow.flo"),
+                                   rng.randn(16, 24, 2).astype(np.float32))
+        split = tmp_path / "chairs_split.txt"
+        split.write_text("1\n2\n1\n")  # samples 1,3 train / 2 val
+        train = FlyingChairs(aug_params=None, split="training",
+                             root=str(data), split_file=str(split))
+        val = FlyingChairs(aug_params=None, split="validation",
+                           root=str(data), split_file=str(split))
+        assert len(train) == 2 and len(val) == 1
+
+    def test_kitti_sparse(self, tmp_path):
+        rng = np.random.RandomState(0)
+        img_dir = tmp_path / "KITTI/training/image_2"
+        flow_dir = tmp_path / "KITTI/training/flow_occ"
+        os.makedirs(img_dir)
+        os.makedirs(flow_dir)
+        for i in range(2):
+            for k in (10, 11):
+                Image.fromarray(
+                    rng.randint(0, 255, (20, 30, 3)).astype(np.uint8)
+                ).save(str(img_dir / f"{i:06d}_{k}.png"))
+            frame_utils.write_flow_kitti(
+                str(flow_dir / f"{i:06d}_10.png"),
+                rng.randn(20, 30, 2).astype(np.float32) * 5)
+        ds = KITTI(aug_params=None, split="training",
+                   root=str(tmp_path / "KITTI"))
+        assert len(ds) == 2
+        img1, img2, flow, valid = ds[0]
+        assert flow.shape == (20, 30, 2)
+        assert set(np.unique(valid)) <= {0.0, 1.0}
+
+
+class TestPrefetchLoader:
+    class TinyDataset:
+        def __init__(self, n=10):
+            self.n = n
+
+        def __len__(self):
+            return self.n
+
+        def __getitem__(self, i):
+            x = np.full((2, 2, 3), i, np.float32)
+            return x, x, np.zeros((2, 2, 2), np.float32), np.ones((2, 2),
+                                                                  np.float32)
+
+    def test_batching_and_determinism(self):
+        ds = self.TinyDataset(10)
+        batches1 = [b["image1"][:, 0, 0, 0] for b in
+                    PrefetchLoader(ds, 3, seed=5, num_workers=2)]
+        batches2 = [b["image1"][:, 0, 0, 0] for b in
+                    PrefetchLoader(ds, 3, seed=5, num_workers=2)]
+        assert len(batches1) == 3  # drop_last
+        for a, b in zip(batches1, batches2):
+            np.testing.assert_array_equal(a, b)
+
+    def test_worker_exception_propagates(self):
+        class Bad(self.TinyDataset):
+            def __getitem__(self, i):
+                raise ValueError("boom")
+
+        with pytest.raises(ValueError, match="boom"):
+            list(PrefetchLoader(Bad(4), 2, num_workers=2))
+
+
+class TestFlowViz:
+    def test_colorwheel_layout(self):
+        wheel = make_colorwheel()
+        assert wheel.shape == (55, 3)
+        np.testing.assert_array_equal(wheel[0], [255, 0, 0])  # RY start
+
+    def test_fixed_rad_normalization_is_frame_consistent(self):
+        """The fork pins rad_max=3 (flow_viz.py:128-130): the same vector
+        maps to the same color regardless of other content."""
+        a = np.zeros((4, 4, 2), np.float32)
+        a[0, 0] = [1.0, 0.0]
+        b = a.copy()
+        b[3, 3] = [300.0, 0.0]  # would change per-frame-max normalization
+        ia = flow_to_image(a)
+        ib = flow_to_image(b)
+        np.testing.assert_array_equal(ia[0, 0], ib[0, 0])
+        # upstream behavior restored with rad_max=None
+        ja = flow_to_image(a, rad_max=None)
+        jb = flow_to_image(b, rad_max=None)
+        assert not np.array_equal(ja[0, 0], jb[0, 0])

@@ -1,0 +1,99 @@
+"""Validator metric math with stubbed forwards — pins EPE aggregation and
+the KITTI F1-all definition (evaluate.py:118-124,148-163) without weights
+or datasets on disk."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.evaluation import evaluate as ev
+
+
+class FakeKITTI:
+    """Two sparse-GT frames with hand-picked flows."""
+
+    def __init__(self, *a, **k):
+        h, w = 16, 16
+        gt = np.zeros((h, w, 2), np.float32)
+        gt[0, 0] = [10.0, 0.0]
+        valid = np.zeros((h, w), np.float32)
+        valid[0, 0] = 1.0   # one valid pixel per frame
+        valid[0, 1] = 1.0   # gt zero here
+        self.samples = [
+            (np.zeros((h, w, 3), np.float32), np.zeros((h, w, 3), np.float32),
+             gt, valid),
+            (np.zeros((h, w, 3), np.float32), np.zeros((h, w, 3), np.float32),
+             np.zeros((h, w, 2), np.float32), valid),
+        ]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+def fake_forward_returning(flow_value):
+    """make_forward stub: prediction = constant flow everywhere."""
+
+    def make_forward(config, iters):
+        def fwd(variables, i1, i2):
+            B, H, W, _ = i1.shape
+            flow = jnp.broadcast_to(
+                jnp.asarray(flow_value, jnp.float32), (B, H, W, 2))
+            return flow, flow
+
+        return fwd, fwd
+
+    return make_forward
+
+
+class TestKITTIF1:
+    def test_f1_counts_large_relative_outliers(self, monkeypatch):
+        # prediction [6, 0] everywhere:
+        # frame 1 pixel (0,0): gt [10,0] -> epe 4 > 3, epe/mag 0.4 > .05 ✓out
+        #          pixel (0,1): gt 0 -> epe 6 > 3, ratio inf ✓ outlier
+        # frame 2 both pixels gt 0 -> epe 6 ✓ outliers
+        monkeypatch.setattr(ev, "make_forward", fake_forward_returning([6, 0]))
+        monkeypatch.setattr(ev.ds, "KITTI", FakeKITTI)
+        res = ev.validate_kitti({}, RAFTConfig(small=True))
+        assert res["kitti-f1"] == pytest.approx(100.0)
+        assert res["kitti-epe"] == pytest.approx((5.0 + 6.0) / 2)
+
+    def test_f1_spares_small_relative_error(self, monkeypatch):
+        # prediction [9.8, 0]: pixel (0,0) epe 0.2 (inlier);
+        # pixel (0,1) gt 0 -> epe 9.8 outlier => half the valid pixels per
+        # frame 1; frame 2: both outliers
+        monkeypatch.setattr(ev, "make_forward",
+                            fake_forward_returning([9.8, 0]))
+        monkeypatch.setattr(ev.ds, "KITTI", FakeKITTI)
+        res = ev.validate_kitti({}, RAFTConfig(small=True))
+        assert res["kitti-f1"] == pytest.approx(100.0 * 3 / 4)
+
+
+class FakeSintel:
+    def __init__(self, *a, split="training", dstype="clean", **k):
+        h, w = 8, 8
+        gt = np.full((h, w, 2), 2.0, np.float32)
+        self.samples = [(np.zeros((h, w, 3), np.float32),
+                         np.zeros((h, w, 3), np.float32), gt,
+                         np.ones((h, w), np.float32))] * 2
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class TestSintelEPE:
+    def test_epe_mean_of_per_image_means(self, monkeypatch):
+        monkeypatch.setattr(ev, "make_forward",
+                            fake_forward_returning([2.0, 2.0]))
+        monkeypatch.setattr(ev.ds, "MpiSintel", FakeSintel)
+        res = ev.validate_sintel({}, RAFTConfig(small=True))
+        # prediction==gt in u, off by 0 in v? pred [2,2] vs gt [2,2]: epe 0
+        assert res["clean"] == pytest.approx(0.0)
+        assert res["final"] == pytest.approx(0.0)

@@ -1,0 +1,25 @@
+"""forward_interpolate (warm-start) tests vs its mathematical definition
+(core/utils/utils.py:26-54 semantics): forward-warp then nearest-fill."""
+
+import numpy as np
+
+from raft_tpu.ops.interp import forward_interpolate
+
+
+class TestForwardInterpolate:
+    def test_zero_flow_is_identity(self):
+        flow = np.zeros((6, 8, 2), np.float32)
+        np.testing.assert_array_equal(forward_interpolate(flow), flow)
+
+    def test_uniform_shift_survives_warp(self):
+        """A constant flow warps onto a shifted grid; nearest interpolation
+        back onto the integer grid reproduces the constant field."""
+        flow = np.full((8, 10, 2), 1.0, np.float32)
+        out = forward_interpolate(flow)
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_shape_and_dtype(self, rng):
+        flow = rng.randn(5, 7, 2).astype(np.float32) * 2
+        out = forward_interpolate(flow)
+        assert out.shape == (5, 7, 2) and out.dtype == np.float32
+        assert np.isfinite(out).all()

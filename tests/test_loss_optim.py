@@ -1,0 +1,94 @@
+"""Loss math vs hand computation; OneCycle schedule vs torch's OneCycleLR.
+
+Pins the parity surface the reference defines at train.py:42-86: γ-weighted
+sequence loss with the MAX_FLOW cutoff, EPE/inlier metrics, and the
+AdamW + OneCycleLR(pct_start=0.05, anneal='linear') optimizer.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from raft_tpu.training.loss import sequence_loss
+from raft_tpu.training.optim import onecycle_linear_schedule
+
+
+class TestSequenceLoss:
+    def test_matches_hand_computation(self, rng):
+        T, B, H, W = 3, 2, 4, 5
+        preds = rng.randn(T, B, H, W, 2).astype(np.float32)
+        gt = rng.randn(B, H, W, 2).astype(np.float32)
+        valid = (rng.rand(B, H, W) > 0.3).astype(np.float32)
+        gamma = 0.8
+
+        loss, metrics = sequence_loss(jnp.asarray(preds), jnp.asarray(gt),
+                                      jnp.asarray(valid), gamma)
+
+        mask = valid >= 0.5  # all mags < 400 here
+        want = 0.0
+        for i in range(T):
+            w = gamma ** (T - 1 - i)
+            i_loss = np.abs(preds[i] - gt)
+            # reference averages over ALL elements (train.py:60)
+            want += w * (mask[..., None] * i_loss).mean()
+        np.testing.assert_allclose(float(loss), want, rtol=1e-6)
+
+        epe = np.sqrt(((preds[-1] - gt) ** 2).sum(-1))
+        np.testing.assert_allclose(float(metrics["epe"]),
+                                   epe[mask].mean(), rtol=1e-6)
+        np.testing.assert_allclose(float(metrics["3px"]),
+                                   (epe[mask] < 3).mean(), rtol=1e-6)
+
+    def test_max_flow_cutoff(self, rng):
+        """GT displacements >= 400 px are excluded (train.py:42,53-55)."""
+        preds = np.zeros((1, 1, 2, 2, 2), np.float32)
+        gt = np.zeros((1, 2, 2, 2), np.float32)
+        gt[0, 0, 0] = [500.0, 0.0]  # excluded
+        gt[0, 0, 1] = [3.0, 4.0]    # epe 5 at zero prediction
+        valid = np.ones((1, 2, 2), np.float32)
+        loss, metrics = sequence_loss(jnp.asarray(preds), jnp.asarray(gt),
+                                      jnp.asarray(valid), 0.8)
+        # loss averages |pred-gt| over all elems but only valid∧(<400) count
+        want = (3 + 4) / preds[0].size
+        np.testing.assert_allclose(float(loss), want, rtol=1e-6)
+        np.testing.assert_allclose(float(metrics["epe"]), 5.0 / 3, rtol=1e-6)
+
+    def test_gamma_weights_recent_iterations_more(self, rng):
+        preds = rng.randn(4, 1, 3, 3, 2).astype(np.float32)
+        gt = np.zeros((1, 3, 3, 2), np.float32)
+        valid = np.ones((1, 3, 3), np.float32)
+        # make the last iteration perfect: loss should drop by the largest
+        # weight's share
+        perfect = preds.copy()
+        perfect[-1] = 0.0
+        l_all, _ = sequence_loss(jnp.asarray(preds), jnp.asarray(gt),
+                                 jnp.asarray(valid), 0.8)
+        l_per, _ = sequence_loss(jnp.asarray(perfect), jnp.asarray(gt),
+                                 jnp.asarray(valid), 0.8)
+        drop = float(l_all) - float(l_per)
+        assert drop == pytest.approx(np.abs(preds[-1]).mean(), rel=1e-5)
+
+
+class TestOneCycle:
+    @pytest.mark.parametrize("lr,steps", [(4e-4, 1000), (1.25e-4, 333)])
+    def test_matches_torch_onecycle(self, lr, steps):
+        """train.py:83-84: OneCycleLR(lr, steps+100, pct_start=0.05,
+        cycle_momentum=False, anneal_strategy='linear')."""
+        total = steps + 100
+        sched = onecycle_linear_schedule(lr, total)
+
+        p = torch.nn.Parameter(torch.zeros(1))
+        opt = torch.optim.AdamW([p], lr=lr)
+        tsched = torch.optim.lr_scheduler.OneCycleLR(
+            opt, lr, total_steps=total, pct_start=0.05,
+            cycle_momentum=False, anneal_strategy="linear")
+
+        got, want = [], []
+        for step in range(total - 1):
+            # torch's get_last_lr after n step() calls == lr used at step n
+            tsched.step()
+            want.append(tsched.get_last_lr()[0])
+            got.append(float(sched(step + 1)))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=1e-9)

@@ -2,6 +2,7 @@
 (SURVEY.md §4(d): multi-chip tests without hardware)."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +50,42 @@ def batch_sharding_2d(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     return NamedSharding(mesh, P("data", None))
+
+
+class TestShardingEquivalence:
+    def test_spatial_sharding_matches_single_device(self, rng):
+        """The (data x spatial) sharded train step must produce the same
+        loss/metrics as an unsharded run — XLA's inserted collectives
+        (psum, halo exchanges) are an implementation detail, not semantics."""
+        from raft_tpu.config import RAFTConfig, TrainConfig
+        from raft_tpu.training.train_step import (create_train_state,
+                                                  make_train_step)
+        import jax.numpy as jnp
+
+        model_cfg = RAFTConfig(small=True)
+        train_cfg = TrainConfig(stage="chairs", num_steps=10, batch_size=4,
+                                iters=2)
+        batch_np = {
+            "image1": rng.rand(4, 32, 32, 3).astype(np.float32) * 255,
+            "image2": rng.rand(4, 32, 32, 3).astype(np.float32) * 255,
+            "flow": rng.randn(4, 32, 32, 2).astype(np.float32),
+            "valid": np.ones((4, 32, 32), np.float32),
+        }
+        key = jax.random.PRNGKey(0)
+
+        losses = {}
+        for spatial in (1, 2):
+            mesh = make_mesh(4 if spatial == 1 else 8, spatial=spatial)
+            state = create_train_state(model_cfg, train_cfg,
+                                       jax.random.PRNGKey(7),
+                                       image_hw=(32, 32))
+            step = jax.jit(make_train_step(model_cfg, train_cfg))
+            with mesh:
+                state = jax.device_put(state, replicated(mesh))
+                sharded = shard_batch(batch_np, mesh)
+                _, metrics = step(state, sharded, key)
+                losses[spatial] = float(metrics["loss"])
+        assert losses[1] == pytest.approx(losses[2], rel=1e-4)
 
 
 class TestDistributed:
