@@ -9,12 +9,23 @@ target is "beat 2xV100 FlyingChairs wall-clock" — public RAFT training logs
 put the 2-GPU recipe at ~2 steps/s with batch 10, i.e. ~20 img-pairs/s, so
 ``vs_baseline`` is value/20 for the whole 2-GPU reference rig (not per GPU).
 
+Survivability rules (learned from round 1, BENCH_r01.json rc=124):
+- start at batch 6 (batch 10 OOMs on the 15.75 GB v5e-1); only retry
+  smaller batches on OOM/RESOURCE_EXHAUSTED — any other failure (e.g.
+  backend init) is fatal and emits the failure JSON immediately;
+- a wall-clock deadline bounds total attempts so one bad compile can't
+  eat the driver's window;
+- throughput is measured with a *blocked* per-step timing loop (median of
+  per-step times with block_until_ready each step): the async dispatch
+  queue produced a physically impossible 3186 pairs/s in round 1.
+
 Prints exactly ONE JSON line:
     {"metric": ..., "value": N, "unit": "img_pairs_per_sec", "vs_baseline": N}
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import time
@@ -31,16 +42,27 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 BASELINE_PAIRS_PER_SEC = 20.0  # est. 2xV100 reference recipe (see docstring)
 IMAGE_HW = (368, 496)          # train_standard.sh chairs crop
 ITERS = 12                     # train.py:232
-WARMUP_STEPS = 3
-TIMED_STEPS = 12
+
+START = time.monotonic()
 
 
-def build(batch_size):
+def log(msg):
+    print(f"[bench +{time.monotonic() - START:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def is_oom(exc: Exception) -> bool:
+    s = f"{type(exc).__name__}: {exc}"
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s or "OOM" in s)
+
+
+def build(batch_size, remat):
     from raft_tpu.config import RAFTConfig, stage_config
     from raft_tpu.training.train_step import (create_train_state,
                                               make_train_step)
 
-    model_cfg = RAFTConfig(small=False, mixed_precision=True)
+    model_cfg = RAFTConfig(small=False, mixed_precision=True, remat=remat)
     train_cfg = stage_config("chairs", batch_size=batch_size)
     rng = jax.random.PRNGKey(0)
     state = create_train_state(model_cfg, train_cfg, rng, image_hw=IMAGE_HW)
@@ -60,43 +82,76 @@ def build(batch_size):
     return state, step, batch, rng
 
 
-def run(batch_size):
-    state, step, batch, rng = build(batch_size)
-    for _ in range(WARMUP_STEPS):
+def run(batch_size, remat, warmup, steps):
+    log(f"building batch={batch_size} remat={remat}")
+    state, step, batch, rng = build(batch_size, remat)
+    log("compiling + warmup")
+    for _ in range(warmup):
         state, metrics = step(state, batch, rng)
-    jax.block_until_ready(metrics)
-    t0 = time.perf_counter()
-    for _ in range(TIMED_STEPS):
+        jax.block_until_ready(metrics)
+    log("timing (blocked per step)")
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
         state, metrics = step(state, batch, rng)
-    jax.block_until_ready(metrics)
-    dt = time.perf_counter() - t0
-    return batch_size * TIMED_STEPS / dt
+        jax.block_until_ready(metrics)
+        times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    log(f"per-step times: min={min(times):.3f} med={med:.3f} "
+        f"max={max(times):.3f}")
+    return batch_size / med
 
 
-def main():
-    value = None
-    used_batch = None
-    for batch_size in (10, 6, 4, 2, 1):
-        try:
-            value = run(batch_size)
-            used_batch = batch_size
-            break
-        except Exception as exc:  # OOM at this shape -> try smaller batch
-            print(f"batch {batch_size} failed: {exc}", file=sys.stderr)
-    if value is None:
-        print(json.dumps({
-            "metric": "raft_basic_train_chairs_368x496_failed",
-            "value": 0.0, "unit": "img_pairs_per_sec", "vs_baseline": 0.0,
-        }))
-        return
+def emit(metric, value):
     print(json.dumps({
-        "metric": (f"raft_basic_train_chairs_368x496_bf16_b{used_batch}"
-                   f"_iters{ITERS}_1chip"),
+        "metric": metric,
         "value": round(value, 3),
         "unit": "img_pairs_per_sec",
         "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC, 3),
-    }))
+    }), flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batches", type=int, nargs="+", default=[6, 4, 2])
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--deadline-s", type=float, default=2400.0,
+                   help="no new attempt starts after this wall-clock budget")
+    args = p.parse_args()
+
+    try:
+        devs = jax.devices()
+        log(f"devices: {devs}")
+    except Exception as exc:
+        log(f"backend init failed: {exc}")
+        emit("raft_basic_train_chairs_368x496_backend_init_failed", 0.0)
+        return 1
+
+    last_err = None
+    for batch_size in args.batches:
+        if time.monotonic() - START > args.deadline_s:
+            log("deadline reached before attempt")
+            break
+        try:
+            value = run(batch_size, args.remat, args.warmup, args.steps)
+        except Exception as exc:
+            last_err = exc
+            if is_oom(exc):
+                log(f"batch {batch_size} OOM, trying smaller")
+                continue
+            log(f"fatal (non-OOM): {type(exc).__name__}: {exc}")
+            break
+        tag = "_remat" if args.remat else ""
+        emit(f"raft_basic_train_chairs_368x496_bf16_b{batch_size}"
+             f"_iters{ITERS}_1chip{tag}", value)
+        return 0
+
+    log(f"no successful run; last error: {last_err}")
+    emit("raft_basic_train_chairs_368x496_failed", 0.0)
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

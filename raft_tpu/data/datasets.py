@@ -168,9 +168,12 @@ class FlyingChairs(FlowDataset):
         assert len(images) // 2 == len(flows)
 
         if split_file is None:
+            # bundled manifest last: explicit/dataset-local copies win
             for cand in ("chairs_split.txt",
                          osp.join(root, "chairs_split.txt"),
-                         osp.join(root, "..", "chairs_split.txt")):
+                         osp.join(root, "..", "chairs_split.txt"),
+                         osp.join(osp.dirname(osp.abspath(__file__)),
+                                  "chairs_split.txt")):
                 if osp.exists(cand):
                     split_file = cand
                     break

@@ -29,12 +29,21 @@ def _build() -> Optional[str]:
     if os.path.exists(_SO) and (os.path.getmtime(_SO)
                                 >= os.path.getmtime(_SRC)):
         return _SO
+    # Compile to a per-process temp path, then os.rename (atomic on POSIX):
+    # concurrent processes never observe a half-written .so, and a rebuild
+    # replaces the inode without touching a library another process mapped.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _SO]
+           _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.rename(tmp, _SO)
         return _SO
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
 
 

@@ -27,6 +27,43 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+# XLA SPMD miscompiles convolutions inside a ``lax.scan`` body when the
+# conv's halo (kernel//2) reaches the per-shard extent of the sharded
+# height dim: with shard_rows <= halo the in-loop halo exchange returns
+# wrong rows (empirically: a scanned 7x7 conv over 2- or 3-row shards
+# diverges by O(1e3) from the unsharded run, while 4-row shards are exact
+# to 4e-4 in both forward and grad; the same conv OUTSIDE scan is exact at
+# every extent). RAFT's largest feature-resolution kernel is the 7x7
+# motion-encoder conv (halo 3) inside the scanned refinement loop, so
+# spatial sharding requires strictly more than MAX_FEATURE_HALO feature
+# rows (H/8) per shard.
+MAX_FEATURE_HALO = 3
+
+
+def validate_spatial_extent(image_h: int, mesh: Mesh) -> None:
+    """Reject spatial shardings XLA cannot execute correctly (see above)."""
+    spatial = dict(zip(mesh.axis_names, mesh.devices.shape)).get("spatial", 1)
+    if spatial <= 1:
+        return
+    h_feat = image_h // 8
+    if h_feat % spatial != 0:
+        # Uneven feature-row sharding makes GSPMD pad the trailing shard;
+        # the miscompile above was only characterized for even division, so
+        # refuse rather than risk padded-shard halo behavior in-scan.
+        raise ValueError(
+            f"spatial={spatial} does not evenly divide the feature height "
+            f"{h_feat} (= H{image_h}//8); uneven spatial shards are "
+            f"unvalidated against the in-scan conv-halo miscompile — pick "
+            f"H with H/8 divisible by the 'spatial' axis.")
+    if (h_feat // spatial) <= MAX_FEATURE_HALO:
+        raise ValueError(
+            f"spatial={spatial} sharding of H={image_h} images gives "
+            f"{h_feat // spatial} feature rows per shard; the scanned update "
+            f"block's 7x7 conv (halo {MAX_FEATURE_HALO}) needs > "
+            f"{MAX_FEATURE_HALO} rows per shard — use taller images or a "
+            f"smaller 'spatial' axis.")
+
+
 def make_mesh(n_devices: Optional[int] = None, spatial: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
     """Mesh of shape (data = n/spatial, spatial)."""
@@ -56,6 +93,10 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def shard_batch(batch: dict, mesh: Mesh) -> dict:
     """Device-put a host batch dict onto the mesh with train shardings."""
+    for v in batch.values():
+        if v.ndim == 4:
+            validate_spatial_extent(v.shape[1], mesh)
+            break
     out = {}
     for k, v in batch.items():
         if v.ndim == 4:

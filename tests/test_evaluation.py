@@ -97,3 +97,31 @@ class TestSintelEPE:
         # prediction==gt in u, off by 0 in v? pred [2,2] vs gt [2,2]: epe 0
         assert res["clean"] == pytest.approx(0.0)
         assert res["final"] == pytest.approx(0.0)
+
+
+class TestShapeBucketing:
+    def test_kitti_sizes_share_one_bucket_and_crop_restores(self):
+        """All real KITTI-15 frame sizes must land in ONE padded shape
+        (one jit compile for the whole dataset), and crop+unpad must
+        restore the original geometry with the interior untouched."""
+        rng = np.random.RandomState(0)
+        shapes = [(375, 1242), (370, 1224), (374, 1238), (376, 1241)]
+        buckets = set()
+        for h, w in shapes:
+            img = rng.rand(h, w, 3).astype(np.float32)
+            i1, i2, padder, crop = ev._to_device_pair(img, img, "kitti",
+                                                      bucket=64)
+            buckets.add(i1.shape)
+            # crop+unpad round-trips the padded image back to the original
+            back = padder.unpad(ev._crop(i1, crop))
+            np.testing.assert_array_equal(np.asarray(back)[0], img)
+            flow = jnp.zeros((1, i1.shape[1], i1.shape[2], 2))
+            out = padder.unpad(ev._crop(flow, crop))
+            assert out.shape == (1, h, w, 2)
+        assert buckets == {(1, 384, 1280, 3)}
+
+    def test_no_bucket_keeps_exact_padded_shape(self):
+        img = np.zeros((375, 1242, 3), np.float32)
+        i1, _, _, crop = ev._to_device_pair(img, img, "kitti", bucket=None)
+        assert i1.shape == (1, 376, 1248, 3)
+        assert crop == (376, 1248)

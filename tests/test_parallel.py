@@ -21,15 +21,15 @@ class TestMesh:
     def test_shard_batch_layouts(self, rng):
         mesh = make_mesh(8, spatial=2)
         batch = {
-            "image1": rng.rand(4, 16, 16, 3).astype(np.float32),
-            "valid": np.ones((4, 16, 16), np.float32),
+            "image1": rng.rand(4, 64, 16, 3).astype(np.float32),
+            "valid": np.ones((4, 64, 16), np.float32),
         }
         sharded = shard_batch(batch, mesh)
         # batch dim split 4-way, height split 2-way
-        db = sharded["image1"].sharding.shard_shape((4, 16, 16, 3))
-        assert db == (1, 8, 16, 3)
-        dv = sharded["valid"].sharding.shard_shape((4, 16, 16))
-        assert dv == (1, 8, 16)
+        db = sharded["image1"].sharding.shard_shape((4, 64, 16, 3))
+        assert db == (1, 32, 16, 3)
+        dv = sharded["valid"].sharding.shard_shape((4, 64, 16))
+        assert dv == (1, 32, 16)
 
     def test_psum_over_data_axis(self):
         """XLA inserts the gradient reduction; emulate with explicit jit."""
@@ -56,20 +56,26 @@ class TestShardingEquivalence:
     def test_spatial_sharding_matches_single_device(self, rng):
         """The (data x spatial) sharded train step must produce the same
         loss/metrics as an unsharded run — XLA's inserted collectives
-        (psum, halo exchanges) are an implementation detail, not semantics."""
+        (psum, halo exchanges) are an implementation detail, not semantics.
+
+        Images are 64x64 so each spatial shard holds 4 feature rows —
+        the minimum extent XLA partitions correctly inside the scanned
+        refinement loop (see mesh.MAX_FEATURE_HALO): smaller shards hit
+        an XLA bug where in-scan conv halo exchanges return wrong rows,
+        which shard_batch now rejects (test below).
+        """
         from raft_tpu.config import RAFTConfig, TrainConfig
         from raft_tpu.training.train_step import (create_train_state,
                                                   make_train_step)
-        import jax.numpy as jnp
 
         model_cfg = RAFTConfig(small=True)
         train_cfg = TrainConfig(stage="chairs", num_steps=10, batch_size=4,
                                 iters=2)
         batch_np = {
-            "image1": rng.rand(4, 32, 32, 3).astype(np.float32) * 255,
-            "image2": rng.rand(4, 32, 32, 3).astype(np.float32) * 255,
-            "flow": rng.randn(4, 32, 32, 2).astype(np.float32),
-            "valid": np.ones((4, 32, 32), np.float32),
+            "image1": rng.rand(4, 64, 64, 3).astype(np.float32) * 255,
+            "image2": rng.rand(4, 64, 64, 3).astype(np.float32) * 255,
+            "flow": rng.randn(4, 64, 64, 2).astype(np.float32),
+            "valid": np.ones((4, 64, 64), np.float32),
         }
         key = jax.random.PRNGKey(0)
 
@@ -78,7 +84,7 @@ class TestShardingEquivalence:
             mesh = make_mesh(4 if spatial == 1 else 8, spatial=spatial)
             state = create_train_state(model_cfg, train_cfg,
                                        jax.random.PRNGKey(7),
-                                       image_hw=(32, 32))
+                                       image_hw=(64, 64))
             step = jax.jit(make_train_step(model_cfg, train_cfg))
             with mesh:
                 state = jax.device_put(state, replicated(mesh))
@@ -86,6 +92,16 @@ class TestShardingEquivalence:
                 _, metrics = step(state, sharded, key)
                 losses[spatial] = float(metrics["loss"])
         assert losses[1] == pytest.approx(losses[2], rel=1e-4)
+
+    def test_shard_batch_rejects_sub_halo_spatial_extent(self, rng):
+        """32x32 images over spatial=2 leave 2 feature rows per shard —
+        inside the scanned update block XLA miscompiles conv halos at
+        that extent (halo 3 of the 7x7 motion conv >= shard rows), so
+        shard_batch must refuse rather than return wrong numbers."""
+        mesh = make_mesh(8, spatial=2)
+        batch = {"image1": rng.rand(4, 32, 32, 3).astype(np.float32)}
+        with pytest.raises(ValueError, match="feature rows per shard"):
+            shard_batch(batch, mesh)
 
 
 class TestDistributed:
