@@ -12,7 +12,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 
@@ -76,11 +76,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                    p_i32, ctypes.POINTER(ctypes.c_int64)]
         lib.pfm_read.argtypes = [ctypes.c_char_p, p_f32, i32, i32, i32, i32,
                                  ctypes.c_int64]
-        lib.assemble_batch_u8.argtypes = [
-            ctypes.POINTER(ctypes.c_void_p), p_i32, p_i32, i32, i32, i32,
-            i32, i32, i32, p_f32, i32]
         for fn in (lib.flo_header, lib.flo_read, lib.flo_write,
-                   lib.pfm_header, lib.pfm_read, lib.assemble_batch_u8):
+                   lib.pfm_header, lib.pfm_read):
             fn.restype = i32
         _lib = lib
         return _lib
@@ -139,37 +136,10 @@ def read_pfm(path: str) -> Optional[np.ndarray]:
     return out
 
 
-def assemble_batch(images, offsets: np.ndarray,
-                   crop_hw: Tuple[int, int],
-                   n_threads: int = 4) -> Optional[np.ndarray]:
-    """Fused crop+cast+stack: list of HWC uint8 arrays (same shape) plus
-    per-sample (y, x) offsets -> (N, ch, cw, C) float32.
-
-    Opt-in fast path for pipelines that defer cropping to collate time
-    (the stock augmentors crop per-sample, so ``PrefetchLoader`` does not
-    route through this). Returns None on any precondition failure so
-    callers can fall back to numpy.
-    """
-    lib = get_lib()
-    if lib is None or not images:
-        return None
-    full_h, full_w, c = images[0].shape
-    imgs = [np.ascontiguousarray(im, np.uint8) for im in images]
-    if any(im.shape != (full_h, full_w, c) for im in imgs):
-        return None
-    n = len(imgs)
-    ch, cw = crop_hw
-    ys = np.ascontiguousarray(offsets[:, 0], np.int32)
-    xs = np.ascontiguousarray(offsets[:, 1], np.int32)
-    # C reads raw pointers: reject out-of-bounds crops here, like numpy would
-    if (ys.min() < 0 or xs.min() < 0 or ys.max() + ch > full_h
-            or xs.max() + cw > full_w):
-        return None
-    ptrs = (ctypes.c_void_p * n)(
-        *[im.ctypes.data_as(ctypes.c_void_p).value for im in imgs])
-    out = np.empty((n, ch, cw, c), np.float32)
-    rc = lib.assemble_batch_u8(
-        ptrs, ys.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        n, full_h, full_w, ch, cw, c, _f32p(out), n_threads)
-    return out if rc == 0 else None
+# NOTE: a fused native collate (crop+cast+stack, "assemble_batch") lived
+# here through round 1 but was never on the loader's path — the stock
+# augmentors crop per-sample BEFORE collate (a random resize precedes the
+# crop, so cropping cannot move to collate time). Measurement settled it:
+# augmentation is 98% of per-sample pipeline cost, collate ~8%
+# (cli/loader_bench.py on the 1-core deployment host), so the fused path
+# was deleted rather than wired in.

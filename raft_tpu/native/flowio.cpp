@@ -4,9 +4,10 @@
 // *processes* (core/datasets.py:230-231) to hide decode/augment cost; our
 // loader uses threads (raft_tpu/data/loader.py), so the byte-moving inner
 // loops live here, outside the GIL: Middlebury .flo codec
-// (frame_utils.py:10-31,70-99 semantics), PFM decode (frame_utils.py:33-68),
-// and the batch assembler that fuses per-sample crop + uint8->float32 cast +
-// NHWC stack (the collate hot path) into one parallel pass.
+// (frame_utils.py:10-31,70-99 semantics) and PFM decode
+// (frame_utils.py:33-68). A fused native collate was measured and removed:
+// augmentation dominates the pipeline at 98% of per-sample cost vs 8% for
+// collate (see cli/loader_bench.py), so there is nothing for it to win.
 //
 // Built with plain g++ into _flowio.so; bound via ctypes (no pybind11 in
 // the image). Every entry point returns 0 on success / negative errno-style
@@ -128,44 +129,6 @@ int pfm_read(const char* path, float* out, int32_t w, int32_t h,
     }
     memcpy(out + static_cast<size_t>(y) * row, buf.data(), row * 4);
   }
-  return kOk;
-}
-
-// Fused collate: for each sample i, crop images[i] (uint8, full_h x full_w
-// x C) at (ys[i], xs[i]) to (crop_h, crop_w) and cast to float32 into
-// out NHWC. Threads split the batch; no Python involvement.
-int assemble_batch_u8(const uint8_t** images, const int32_t* ys,
-                      const int32_t* xs, int32_t n, int32_t full_h,
-                      int32_t full_w, int32_t crop_h, int32_t crop_w,
-                      int32_t c, float* out, int32_t n_threads) {
-  if (n <= 0) return kOk;
-  size_t sample = static_cast<size_t>(crop_h) * crop_w * c;
-  auto work = [&](int32_t lo, int32_t hi) {
-    for (int32_t i = lo; i < hi; ++i) {
-      const uint8_t* src = images[i];
-      float* dst = out + static_cast<size_t>(i) * sample;
-      for (int32_t y = 0; y < crop_h; ++y) {
-        const uint8_t* row = src + (static_cast<size_t>(ys[i] + y) * full_w
-                                    + xs[i]) * c;
-        float* drow = dst + static_cast<size_t>(y) * crop_w * c;
-        for (int32_t k = 0; k < crop_w * c; ++k) {
-          drow[k] = static_cast<float>(row[k]);
-        }
-      }
-    }
-  };
-  if (n_threads <= 1 || n == 1) {
-    work(0, n);
-    return kOk;
-  }
-  std::vector<std::thread> ts;
-  int32_t per = (n + n_threads - 1) / n_threads;
-  for (int32_t t = 0; t < n_threads && t * per < n; ++t) {
-    int32_t lo = t * per;
-    int32_t hi = lo + per < n ? lo + per : n;
-    ts.emplace_back(work, lo, hi);
-  }
-  for (auto& t : ts) t.join();
   return kOk;
 }
 

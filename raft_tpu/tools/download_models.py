@@ -1,0 +1,86 @@
+"""Fetch the reference pretrained checkpoints and convert them to flax.
+
+Analog of ``download_models.sh`` (wget models.zip + unzip) with the extra
+step this framework needs: every ``.pth`` is converted through
+``tools/convert.py`` into a flax msgpack next to it, so eval/demo/serving
+never touch torch at runtime.
+
+The checkpoint zip ships raft-chairs/things/sintel/kitti (basic) and
+raft-small; ``--small`` matching is inferred from the filename.
+
+Zero-egress environments: pass ``--zip`` pointing at an already-downloaded
+models.zip (or a directory of .pth files via ``--models-dir``) to skip the
+network step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import os.path as osp
+import sys
+import zipfile
+
+MODELS_URL = "https://www.dropbox.com/s/4j4z58wuv8o0mfz/models.zip"
+
+
+def download(url: str, dest: str) -> str:
+    import urllib.request
+
+    print(f"downloading {url} -> {dest}")
+    urllib.request.urlretrieve(url, dest)
+    return dest
+
+
+def convert_all(models_dir: str) -> int:
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.tools.convert import load_pth, save_converted
+
+    n = 0
+    for name in sorted(os.listdir(models_dir)):
+        if not name.endswith(".pth"):
+            continue
+        src = osp.join(models_dir, name)
+        dst = src[:-4] + ".msgpack"
+        cfg = RAFTConfig(small="small" in name)
+        try:
+            variables = load_pth(src, cfg)
+        except Exception as e:
+            print(f"  {name}: conversion FAILED ({e})", file=sys.stderr)
+            continue
+        save_converted(variables, dst)
+        print(f"  {name} -> {osp.basename(dst)} "
+              f"({'small' if cfg.small else 'basic'})")
+        n += 1
+    return n
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="download + convert reference RAFT checkpoints")
+    p.add_argument("--out", default="models", help="output directory")
+    p.add_argument("--zip", default=None,
+                   help="use an existing models.zip instead of downloading")
+    p.add_argument("--models-dir", default=None,
+                   help="use an existing directory of .pth files")
+    args = p.parse_args(argv)
+
+    if args.models_dir:
+        models_dir = args.models_dir
+    else:
+        os.makedirs(args.out, exist_ok=True)
+        zpath = args.zip or download(MODELS_URL,
+                                     osp.join(args.out, "models.zip"))
+        with zipfile.ZipFile(zpath) as z:
+            z.extractall(args.out)
+        # the reference zip nests everything under models/
+        nested = osp.join(args.out, "models")
+        models_dir = nested if osp.isdir(nested) else args.out
+
+    n = convert_all(models_dir)
+    print(f"converted {n} checkpoints in {models_dir}")
+    return 0 if n else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""Worker for the 2-process distributed smoke test (not a test module).
+
+Each process contributes its local CPU device to a 2-process
+``jax.distributed`` cluster, builds the global mesh, feeds only its rows of
+the global batch through ``host_local_batch``, and runs ONE jitted train
+step — the multi-host path (parallel/distributed.py:40-82) end to end.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+import jax  # noqa: E402
+
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+
+from raft_tpu.config import RAFTConfig, TrainConfig  # noqa: E402
+from raft_tpu.parallel import distributed as dist  # noqa: E402
+from raft_tpu.parallel.mesh import make_mesh, replicated  # noqa: E402
+from raft_tpu.training.train_step import (create_train_state,  # noqa: E402
+                                          make_train_step)
+
+
+def main(process_id: int, port: str) -> None:
+    dist.initialize(f"localhost:{port}", 2, process_id)
+    assert jax.process_count() == 2, jax.process_count()
+
+    mesh = make_mesh()  # all devices across both processes
+    B, H, W = 2, 32, 32
+    model_cfg = RAFTConfig(small=True)
+    train_cfg = TrainConfig(stage="chairs", num_steps=10, batch_size=B,
+                            iters=1)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(model_cfg, train_cfg, rng, image_hw=(H, W))
+    step = jax.jit(make_train_step(model_cfg, train_cfg))
+
+    host = np.random.RandomState(0)
+    gbatch = {
+        "image1": host.rand(B, H, W, 3).astype(np.float32) * 255,
+        "image2": host.rand(B, H, W, 3).astype(np.float32) * 255,
+        "flow": host.randn(B, H, W, 2).astype(np.float32),
+        "valid": np.ones((B, H, W), np.float32),
+    }
+    sl = dist.process_batch_slice(B)
+    local = {k: v[sl] for k, v in gbatch.items()}
+    with mesh:
+        state = jax.device_put(state, replicated(mesh))
+        sharded = dist.host_local_batch(local, mesh)
+        _, metrics = step(state, sharded, rng)
+    print(f"RESULT pid={process_id} loss={float(metrics['loss']):.6f} "
+          f"procs={jax.process_count()} devices={len(jax.devices())}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), sys.argv[2])

@@ -201,3 +201,30 @@ class TestLayerNumericParity:
 
         got = np.asarray(block.apply(variables, jnp.asarray(x)))
         np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+
+class TestDownloadModels:
+    def test_offline_zip_convert(self, small_vars, tmp_path):
+        """download_models --zip path: unzip -> convert every .pth to
+        msgpack (the zero-egress route of tools/download_models.py)."""
+        import zipfile
+
+        from raft_tpu.tools.download_models import main
+
+        _, variables = small_vars
+        sd = {k: torch.from_numpy(v)
+              for k, v in synth_state_dict(variables).items()}
+        pth = tmp_path / "raft-small.pth"
+        torch.save(sd, pth)
+        z = tmp_path / "models.zip"
+        with zipfile.ZipFile(z, "w") as zf:
+            zf.write(pth, "models/raft-small.pth")
+
+        out = tmp_path / "out"
+        assert main(["--out", str(out), "--zip", str(z)]) == 0
+        assert (out / "models" / "raft-small.msgpack").exists()
+
+    def test_models_dir_without_pth_fails(self, tmp_path):
+        from raft_tpu.tools.download_models import main
+
+        assert main(["--models-dir", str(tmp_path)]) == 1

@@ -116,11 +116,21 @@ class TestModelIntegration:
         for impl in ["gather", "onehot"]:
             model = RAFT(RAFTConfig(small=True, corr_impl=impl))
             variables = model.init(jax.random.PRNGKey(0), img1, img2, iters=1)
-            _, up = model.apply(variables, img1, img2, iters=4,
-                                test_mode=True)
-            flows[impl] = np.asarray(up)
-        # different summation orders drift ~5e-4 after 4 recurrent
-        # iterations on ~1e2-magnitude flows; per-op parity is the tight
-        # check (TestOnehotParity, atol 1e-5)
-        np.testing.assert_allclose(flows["onehot"], flows["gather"],
-                                   atol=5e-3, rtol=1e-3)
+            # train-mode return: (iters, B, H, W, 2) — all iterations
+            flows[impl] = np.asarray(
+                model.apply(variables, img1, img2, iters=4))
+
+        # The impls are algebraically identical; they differ only in fp32
+        # summation order (4-corner weighted sum vs separable lerp of
+        # one-hot GEMM outputs). That rounding difference enters once per
+        # iteration and is amplified by the recurrence. Pin the profile:
+        # the FIRST iteration diff is pure op-level rounding (must be at
+        # the 1e-4 float32 level on ~1e2-magnitude flows), and growth per
+        # iteration stays bounded (< 10x/iter), reaching at most ~5e-3 by
+        # iteration 4 — drift, not divergence.
+        per_iter = np.abs(flows["onehot"] - flows["gather"]).reshape(
+            4, -1).max(axis=1)
+        assert per_iter[0] < 1e-4, f"op-level mismatch: {per_iter}"
+        assert per_iter[-1] < 5e-3, f"drift blow-up: {per_iter}"
+        growth = per_iter[1:] / np.maximum(per_iter[:-1], 1e-12)
+        assert growth.max() < 10.0, f"non-linear amplification: {per_iter}"

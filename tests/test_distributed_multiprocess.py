@@ -1,0 +1,75 @@
+"""2-process ``jax.distributed`` smoke test (VERDICT r1 next-step #9).
+
+Spawns two real OS processes (tests/dist_worker.py), each with one local
+CPU device, wired into one cluster via ``dist.initialize``; each feeds its
+half of the global batch through ``host_local_batch`` and runs one jitted
+train step. Asserts both processes compute the SAME loss, and that it
+matches a single-process run of the identical global batch on a 2-device
+mesh — the only previously-untested path in parallel/distributed.py.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _single_process_loss() -> float:
+    """Same batch/seeds as dist_worker, on an in-process 2-device mesh."""
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.parallel.mesh import make_mesh, replicated, shard_batch
+    from raft_tpu.training.train_step import (create_train_state,
+                                              make_train_step)
+
+    B, H, W = 2, 32, 32
+    model_cfg = RAFTConfig(small=True)
+    train_cfg = TrainConfig(stage="chairs", num_steps=10, batch_size=B,
+                            iters=1)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(model_cfg, train_cfg, rng, image_hw=(H, W))
+    step = jax.jit(make_train_step(model_cfg, train_cfg))
+    host = np.random.RandomState(0)
+    batch = {
+        "image1": host.rand(B, H, W, 3).astype(np.float32) * 255,
+        "image2": host.rand(B, H, W, 3).astype(np.float32) * 255,
+        "flow": host.randn(B, H, W, 2).astype(np.float32),
+        "valid": np.ones((B, H, W), np.float32),
+    }
+    mesh = make_mesh(2)
+    with mesh:
+        state = jax.device_put(state, replicated(mesh))
+        _, metrics = step(state, shard_batch(batch, mesh), rng)
+    return float(metrics["loss"])
+
+
+def test_two_process_train_step_matches_single_process():
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "dist_worker.py")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [subprocess.Popen([sys.executable, worker, str(i), str(port)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for i in range(2)]
+    outs = [p.communicate(timeout=540)[0] for p in procs]
+    losses = []
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
+        m = re.search(r"RESULT pid=\d+ loss=([\d.]+) procs=2 devices=2", out)
+        assert m, f"worker {i} output malformed:\n{out[-2000:]}"
+        losses.append(float(m.group(1)))
+
+    assert losses[0] == losses[1]
+    # same global computation as one process on a 2-device mesh
+    assert losses[0] == pytest.approx(_single_process_loss(), rel=1e-5)

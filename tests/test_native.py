@@ -56,31 +56,6 @@ class TestPfm:
         np.testing.assert_array_equal(got, data)
 
 
-class TestAssembleBatch:
-    def test_matches_numpy_crop_stack(self, rng):
-        images = [rng.randint(0, 255, (20, 30, 3), dtype=np.uint8)
-                  for _ in range(5)]
-        offs = np.stack([rng.randint(0, 10, 5), rng.randint(0, 14, 5)], -1)
-        got = native.assemble_batch(images, offs, (8, 12), n_threads=3)
-        want = np.stack([
-            images[i][offs[i, 0]:offs[i, 0] + 8,
-                      offs[i, 1]:offs[i, 1] + 12].astype(np.float32)
-            for i in range(5)])
-        np.testing.assert_array_equal(got, want)
-
-    def test_shape_mismatch_falls_back(self, rng):
-        images = [np.zeros((4, 4, 3), np.uint8), np.zeros((5, 4, 3), np.uint8)]
-        assert native.assemble_batch(images, np.zeros((2, 2), np.int32),
-                                     (2, 2)) is None
-
-    def test_out_of_bounds_crop_rejected(self):
-        images = [np.zeros((4, 4, 3), np.uint8)]
-        offs = np.array([[3, 0]], np.int32)  # 3 + crop 2 > 4
-        assert native.assemble_batch(images, offs, (2, 2)) is None
-        offs = np.array([[-1, 0]], np.int32)
-        assert native.assemble_batch(images, offs, (2, 2)) is None
-
-
 class TestPfmCRLF:
     def test_crlf_header_matches_numpy(self, tmp_path, rng):
         """Windows-written PFM: header lines end in \\r\\n; the payload must
