@@ -1,0 +1,133 @@
+"""Profile the jitted train step on the current backend (VERDICT r1 #5).
+
+Runs warmup + N timed steps of the chairs-recipe train step on synthetic
+data with per-step ``block_until_ready`` fences, optionally wrapping the
+timed window in a ``jax.profiler`` trace, and prints a timing summary plus
+the cost-model breakdown from XLA's compiled-module analysis (FLOPs,
+bytes accessed, per-device memory) so the hotspot question — corr lookup
+vs GRU convs vs input pipeline — is answerable from one command.
+
+On the real chip:   python -m raft_tpu.cli.profile_step --batch 6
+On CPU (plumbing):  JAX_PLATFORMS=cpu python -m raft_tpu.cli.profile_step \
+                        --batch 1 --hw 64 64 --steps 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=6)
+    p.add_argument("--hw", type=int, nargs=2, default=[368, 496])
+    p.add_argument("--iters", type=int, default=12)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--corr-impl", default=None,
+                   help="override corr_impl (gather/onehot/pallas)")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--fp32", action="store_true",
+                   help="disable bf16 mixed precision")
+    p.add_argument("--trace-dir", default=None,
+                   help="write a jax.profiler trace here (view in XProf)")
+    args = p.parse_args(argv)
+
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/raft_tpu_jax_cache_tpu")
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    from raft_tpu.config import RAFTConfig, stage_config
+    from raft_tpu.training.train_step import (create_train_state,
+                                              make_train_step)
+
+    overrides = {}
+    if args.corr_impl:
+        overrides["corr_impl"] = args.corr_impl
+    model_cfg = RAFTConfig(small=False, mixed_precision=not args.fp32,
+                           remat=args.remat, **overrides)
+    train_cfg = stage_config("chairs", batch_size=args.batch,
+                             iters=args.iters)
+
+    h, w = args.hw
+    rng = jax.random.PRNGKey(0)
+    print(f"backend={jax.default_backend()} batch={args.batch} hw={h}x{w} "
+          f"iters={args.iters} bf16={not args.fp32} remat={args.remat} "
+          f"corr_impl={model_cfg.corr_impl}")
+    t0 = time.perf_counter()
+    state = create_train_state(model_cfg, train_cfg, rng, image_hw=(h, w))
+    step = jax.jit(make_train_step(model_cfg, train_cfg),
+                   donate_argnums=(0,))
+
+    host = np.random.RandomState(0)
+    batch = {
+        "image1": jnp.asarray(
+            host.rand(args.batch, h, w, 3).astype(np.float32) * 255.0),
+        "image2": jnp.asarray(
+            host.rand(args.batch, h, w, 3).astype(np.float32) * 255.0),
+        "flow": jnp.asarray(
+            host.randn(args.batch, h, w, 2).astype(np.float32)),
+        "valid": jnp.ones((args.batch, h, w), jnp.float32),
+    }
+    print(f"init: {time.perf_counter() - t0:.1f}s")
+
+    # cost model from the compiled module (works on every backend)
+    lowered = step.lower(state, batch, rng)
+    compiled = lowered.compile()
+    try:
+        ca = compiled.cost_analysis()
+        flops = ca.get("flops", float("nan"))
+        bytes_acc = ca.get("bytes accessed", float("nan"))
+        print(f"cost model: {flops / 1e12:.2f} TFLOP/step, "
+              f"{bytes_acc / 2**30:.2f} GiB accessed/step, "
+              f"arithmetic intensity {flops / max(bytes_acc, 1):.1f} flop/B")
+    except Exception as e:
+        print(f"cost_analysis unavailable: {e}")
+    try:
+        ma = compiled.memory_analysis()
+        print(f"memory: temp {ma.temp_size_in_bytes / 2**30:.2f} GiB, "
+              f"args {ma.argument_size_in_bytes / 2**30:.2f} GiB "
+              f"per device")
+    except Exception as e:
+        print(f"memory_analysis unavailable: {e}")
+
+    t0 = time.perf_counter()
+    for _ in range(args.warmup):
+        state, metrics = step(state, batch, rng)
+        jax.block_until_ready(metrics)
+    print(f"warmup ({args.warmup} steps incl. compile): "
+          f"{time.perf_counter() - t0:.1f}s")
+
+    if args.trace_dir:
+        jax.profiler.start_trace(args.trace_dir)
+    times = []
+    for _ in range(args.steps):
+        t0 = time.perf_counter()
+        state, metrics = step(state, batch, rng)
+        jax.block_until_ready(metrics)
+        times.append(time.perf_counter() - t0)
+    if args.trace_dir:
+        jax.profiler.stop_trace()
+        print(f"trace written to {args.trace_dir}")
+
+    med = float(np.median(times))
+    print(f"steps: med {med * 1e3:.1f} ms  min {min(times) * 1e3:.1f}  "
+          f"max {max(times) * 1e3:.1f}  -> "
+          f"{args.batch / med:.2f} img-pairs/s")
+    try:
+        flops = compiled.cost_analysis().get("flops", 0.0)
+        print(f"achieved: {flops / med / 1e12:.2f} TFLOP/s")
+    except Exception:
+        pass
+    return med
+
+
+if __name__ == "__main__":
+    main()
