@@ -18,6 +18,9 @@ def main(argv=None):
     p.add_argument("--data_root", default="datasets")
     p.add_argument("--submission", action="store_true",
                    help="write a leaderboard submission instead of validating")
+    p.add_argument("--eval_batch", type=int, default=4,
+                   help="pairs per forward for uniform-size datasets "
+                        "(chairs/sintel); 1 = reference's per-image loop")
     args = p.parse_args(argv)
 
     from raft_tpu.evaluation import evaluate as ev
@@ -40,7 +43,10 @@ def main(argv=None):
 
     fn = {"chairs": ev.validate_chairs, "sintel": ev.validate_sintel,
           "kitti": ev.validate_kitti}[args.dataset]
-    results = fn(variables, cfg, data_root=args.data_root)
+    kwargs = {}
+    if args.dataset in ("chairs", "sintel"):
+        kwargs["batch_size"] = args.eval_batch
+    results = fn(variables, cfg, data_root=args.data_root, **kwargs)
     print(results)
 
 
