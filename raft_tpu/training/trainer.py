@@ -173,10 +173,12 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
 
 
 def train_curriculum(stages, model_cfg: RAFTConfig, name: str = "raft",
-                     mixed: bool = False, **overrides) -> None:
+                     mixed: bool = False, loader_factory=None,
+                     **overrides) -> None:
     """`train_standard.sh` / `train_mixed.sh` analog: chain stages, each
     restoring the previous stage's final weights with a fresh schedule
-    (train_standard.sh:4-6)."""
+    (train_standard.sh:4-6). ``loader_factory(cfg)`` overrides the stage
+    dataloader (tests / custom data)."""
     from raft_tpu.config import stage_config
 
     prev_final: Optional[str] = None
@@ -184,7 +186,8 @@ def train_curriculum(stages, model_cfg: RAFTConfig, name: str = "raft",
         cfg = stage_config(stage, mixed=mixed, name=f"{name}-{stage}",
                            restore_ckpt=prev_final, **overrides)
         t0 = time.perf_counter()
-        train(model_cfg, cfg)
+        train(model_cfg, cfg,
+              loader=loader_factory(cfg) if loader_factory else None)
         print(f"stage {stage} done in {time.perf_counter() - t0:.0f}s",
               flush=True)
         prev_final = os.path.join(cfg.checkpoint_dir,

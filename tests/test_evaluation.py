@@ -167,3 +167,64 @@ class TestBatchedEvalEquivalence:
         assert r1["clean"] == pytest.approx(r3["clean"], rel=1e-6)
         assert r1["final"] == pytest.approx(r3["final"], rel=1e-6)
         assert r1["clean"] > 0  # non-degenerate
+
+
+class FakeSintelTestSplit:
+    """Test-split items: (img1, img2, (sequence, frame)). Two sequences so
+    the warm-start chain must reset at the boundary."""
+
+    def __init__(self, *a, split="training", dstype="clean", **k):
+        h, w = 16, 16
+        img = np.zeros((h, w, 3), np.float32)
+        self.samples = [
+            (img, img, ("alley_1", 0)),
+            (img, img, ("alley_1", 1)),
+            (img, img, ("market_6", 0)),
+        ]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class TestSintelSubmission:
+    def test_warm_start_chain_and_files(self, monkeypatch, tmp_path):
+        """Warm start must use flow_init for consecutive frames of one
+        sequence, reset at sequence boundaries (evaluate.py:30-41), and
+        write frame%04d.flo named from 1 (evaluate.py:47-49)."""
+        calls = {"cold": 0, "warm": 0}
+
+        def make_forward(config, iters):
+            def fwd(variables, i1, i2):
+                calls["cold"] += 1
+                B, H, W, _ = i1.shape
+                flow = jnp.ones((B, H, W, 2), jnp.float32)
+                return flow[:, ::8, ::8] * 0.5, flow
+
+            def fwd_init(variables, i1, i2, flow_init):
+                calls["warm"] += 1
+                B, H, W, _ = i1.shape
+                flow = jnp.full((B, H, W, 2), 2.0, jnp.float32)
+                return flow[:, ::8, ::8] * 0.5, flow
+
+            return fwd, fwd_init
+
+        monkeypatch.setattr(ev, "make_forward", make_forward)
+        monkeypatch.setattr(ev.ds, "MpiSintel", FakeSintelTestSplit)
+        out = str(tmp_path / "sub")
+        ev.create_sintel_submission({}, RAFTConfig(small=True),
+                                    warm_start=True, output_path=out)
+
+        # per dstype: frame0 cold, frame1 warm (same seq), frame0 cold (new)
+        assert calls == {"cold": 4, "warm": 2}
+        for dstype in ("clean", "final"):
+            for seq, frame in [("alley_1", 1), ("alley_1", 2),
+                               ("market_6", 1)]:
+                p = tmp_path / "sub" / dstype / seq / f"frame{frame:04d}.flo"
+                assert p.exists(), p
+        from raft_tpu.data import frame_utils
+        uv = frame_utils.read_flow(
+            str(tmp_path / "sub" / "clean" / "alley_1" / "frame0002.flo"))
+        np.testing.assert_allclose(uv, 2.0)  # warm-start forward's output

@@ -116,3 +116,38 @@ class TestLogger:
         assert recs[1]["epe"] == pytest.approx(5.0)  # (4+6) / freq
         assert recs[-1]["chairs"] == 5.0
         assert glob.glob(os.path.join(log_dir, "events.*"))  # tensorboard
+
+
+class TestCurriculum:
+    def test_two_stage_chain_restores_previous_weights(self, tmp_path,
+                                                       small_cfg,
+                                                       monkeypatch):
+        """train_curriculum must chain stages the way train_standard.sh
+        chains --restore_ckpt: stage N+1 starts from stage N's final
+        weights file with a fresh schedule."""
+        from raft_tpu.training import trainer
+
+        restored = []
+        orig = trainer.load_weights
+
+        def spy(path, config):
+            restored.append(path)
+            return orig(path, config)
+
+        monkeypatch.setattr(trainer, "load_weights", spy)
+
+        ckpt = os.path.join(str(tmp_path), "ckpt")
+        trainer.train_curriculum(
+            ["chairs", "things"], small_cfg, name="c",
+            loader_factory=lambda cfg: SyntheticLoader(
+                batch_size=8, n_batches=2),
+            num_steps=2, batch_size=8, image_size=(64, 64), iters=2,
+            val_freq=10 ** 9, sum_freq=10, checkpoint_dir=ckpt,
+            log_dir=os.path.join(str(tmp_path), "runs"), validation=())
+
+        chairs_final = os.path.join(ckpt, "c-chairs.msgpack")
+        things_final = os.path.join(ckpt, "c-things.msgpack")
+        assert os.path.exists(chairs_final)
+        assert os.path.exists(things_final)
+        # the things stage restored exactly the chairs final weights
+        assert restored == [chairs_final]
