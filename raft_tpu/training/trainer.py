@@ -117,15 +117,28 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
                              if k in ("loss", "epe", "1px", "3px", "5px")})
                 pending_metrics = None
 
+        def device_batches(host_loader, depth=2):
+            """shard_batch runs ``depth`` batches ahead of consumption:
+            jax transfers are async, so H2D of batch N+1 overlaps the
+            device compute of batch N instead of serializing with it."""
+            from collections import deque
+
+            buf = deque()
+            for host_batch in host_loader:
+                buf.append(shard_batch(host_batch, mesh))
+                if len(buf) >= depth:
+                    yield buf.popleft()
+            while buf:
+                yield buf.popleft()
+
         while keep_training:
-            for batch in loader:
+            for sharded in device_batches(loader):
                 if (prof and not profiling
                         and prof[0] <= total_steps < prof[1]):
                     jax.profiler.start_trace(
                         os.path.join(train_cfg.log_dir, train_cfg.name))
                     profiling = True
                 rng, step_rng = jax.random.split(rng)
-                sharded = shard_batch(batch, mesh)
                 state, metrics = step_fn(state, sharded, step_rng)
                 if profiling and total_steps >= prof[1]:
                     jax.block_until_ready(metrics)
