@@ -55,6 +55,11 @@ def sintel_pair():
     (False, "onehot", 12),
     (True, "gather", 20),
     (True, "onehot", 12),
+    # 'alt' = AlternateCorrBlock (the alt_cuda_corr analog): never
+    # materializes the volume, but interpolate-then-dot is algebraically
+    # the same lookup, so it must match the reference's materialized
+    # CorrBlock output too (core/corr.py:63-91).
+    (True, "alt", 12),
 ])
 def test_full_model_flow_matches_reference(torch_raft, sintel_pair, small,
                                            impl, iters):
@@ -78,7 +83,10 @@ def test_full_model_flow_matches_reference(torch_raft, sintel_pair, small,
         flow_t = tmodel(t1, t2, iters=iters, test_mode=True)
     flow_t = flow_t[0].permute(1, 2, 0).numpy()
 
-    cfg = RAFTConfig(small=small, corr_impl=impl)
+    if impl == "alt":
+        cfg = RAFTConfig(small=small, alternate_corr=True)
+    else:
+        cfg = RAFTConfig(small=small, corr_impl=impl)
     jmodel = RAFT(cfg)
     variables = jmodel.init(jax.random.PRNGKey(0), jnp.zeros((1, h, w, 3)),
                             jnp.zeros((1, h, w, 3)), iters=1)
