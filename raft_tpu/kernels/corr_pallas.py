@@ -5,8 +5,8 @@ fetch a (2r+1)² bilinear window from its (Hl, Wl) correlation slice at each
 pyramid level. The CUDA reference solves this with per-pixel shared-memory
 tiles (correlation_kernel.cu:19-119); XLA solves it with general gathers
 (slow on TPU) or one-hot GEMMs (corr_lookup_onehot). This kernel instead
-streams each query's integer (2r+2)² window VMEM-ward with double-buffered
-async DMA straight from the volume in HBM — reading ~P²·4 bytes per query
+streams each query's integer (2r+2)² window VMEM-ward through an
+8-deep ring of async DMAs straight from the volume in HBM — reading ~P²·4 bytes per query
 instead of the whole (Hl, Wl) slice — then applies the separable 2-tap lerp
 on the VPU.
 
@@ -58,6 +58,12 @@ def pallas_available() -> bool:
         return False
 
 
+_NBUF = 8  # DMA ring depth: each window is ~P²·4 B (~400 B), so single
+# transfers are latency-bound, not bandwidth-bound; keeping _NBUF copies in
+# flight hides HBM latency the way the CUDA kernel's block-wide coalesced
+# loads do (correlation_kernel.cu:56-72).
+
+
 def _lookup_kernel(base_ref, frac_ref, vol_ref, out_ref, scratch, sems, *,
                    Q: int, K: int):
     """One grid step: Q queries of one (batch, query-tile) block.
@@ -66,7 +72,7 @@ def _lookup_kernel(base_ref, frac_ref, vol_ref, out_ref, scratch, sems, *,
     frac_ref: SMEM (1, Q, 2) f32 — shared bilinear fracs (wx, wy)
     vol_ref:  ANY  (B, N, Hp, Wp) f32 — padded volume, resident in HBM
     out_ref:  VMEM (1, Q, K²) f32
-    scratch:  VMEM (2, P, P) double buffer; sems: 2 DMA semaphores
+    scratch:  VMEM (_NBUF, P, P) DMA ring; sems: _NBUF DMA semaphores
     """
     P = K + 1
     b = pl.program_id(0)
@@ -81,14 +87,18 @@ def _lookup_kernel(base_ref, frac_ref, vol_ref, out_ref, scratch, sems, *,
             sems.at[slot],
         )
 
-    window_copy(0, 0).start()
+    # prologue: fill all but one ring slot (slot q%_NBUF for query q)
+    for q0 in range(min(_NBUF - 1, Q)):
+        window_copy(q0, q0 % _NBUF).start()
 
     def body(q, _):
-        slot = jax.lax.rem(q, 2)
+        slot = jax.lax.rem(q, _NBUF)
+        # body q-1 freed slot (q-1)%_NBUF == (q+_NBUF-1)%_NBUF: refill it
+        nxt = q + _NBUF - 1
 
-        @pl.when(q + 1 < Q)
+        @pl.when(nxt < Q)
         def _():
-            window_copy(q + 1, jax.lax.rem(q + 1, 2)).start()
+            window_copy(nxt, jax.lax.rem(nxt, _NBUF)).start()
 
         window_copy(q, slot).wait()
         win = scratch[slot]                       # (P, P) [y, x]
@@ -144,8 +154,8 @@ def _level_lookup_pallas(vol: jax.Array, x: jax.Array, y: jax.Array,
         out_specs=pl.BlockSpec((1, q_tile, K * K), lambda b, t: (b, t, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Np, K * K), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((2, P, P), jnp.float32),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((_NBUF, P, P), jnp.float32),
+            pltpu.SemaphoreType.DMA((_NBUF,)),
         ],
         interpret=_INTERPRET,
     )(base, frac, vol_p.astype(jnp.float32))
