@@ -9,15 +9,20 @@ target is "beat 2xV100 FlyingChairs wall-clock" — public RAFT training logs
 put the 2-GPU recipe at ~2 steps/s with batch 10, i.e. ~20 img-pairs/s, so
 ``vs_baseline`` is value/20 for the whole 2-GPU reference rig (not per GPU).
 
-Survivability rules (learned from round 1, BENCH_r01.json rc=124):
+Survivability rules (learned from rounds 1-2):
 - start at batch 6 (batch 10 OOMs on the 15.75 GB v5e-1); only retry
   smaller batches on OOM/RESOURCE_EXHAUSTED — any other failure (e.g.
   backend init) is fatal and emits the failure JSON immediately;
 - a wall-clock deadline bounds total attempts so one bad compile can't
   eat the driver's window;
-- throughput is measured with a *blocked* per-step timing loop (median of
-  per-step times with block_until_ready each step): the async dispatch
-  queue produced a physically impossible 3186 pairs/s in round 1.
+- timing forces a CONCRETE VALUE FETCH (float() of the loss and of a
+  param leaf of the final train state) after a chained run of N steps.
+  On the remote 'axon' backend even ``jax.block_until_ready`` returns
+  before execution finishes (round 2 measured 1.7 ms/step "blocked" =
+  1013 TFLOP/s on a 197 TFLOP/s chip — impossible); a host-side float()
+  of data that transitively depends on every step cannot lie. Each step
+  consumes the previous step's (donated) state, so the chain serializes
+  on real data dependencies and the final fetch waits for all of it.
 
 Prints exactly ONE JSON line:
     {"metric": ..., "value": N, "unit": "img_pairs_per_sec", "vs_baseline": N}
@@ -57,12 +62,14 @@ def is_oom(exc: Exception) -> bool:
             or "out of memory" in s or "OOM" in s)
 
 
-def build(batch_size, remat):
+def build(batch_size, remat, corr_impl=None):
     from raft_tpu.config import RAFTConfig, stage_config
     from raft_tpu.training.train_step import (create_train_state,
                                               make_train_step)
 
-    model_cfg = RAFTConfig(small=False, mixed_precision=True, remat=remat)
+    overrides = {"corr_impl": corr_impl} if corr_impl else {}
+    model_cfg = RAFTConfig(small=False, mixed_precision=True, remat=remat,
+                          **overrides)
     train_cfg = stage_config("chairs", batch_size=batch_size)
     rng = jax.random.PRNGKey(0)
     state = create_train_state(model_cfg, train_cfg, rng, image_hw=IMAGE_HW)
@@ -82,24 +89,35 @@ def build(batch_size, remat):
     return state, step, batch, rng
 
 
-def run(batch_size, remat, warmup, steps):
-    log(f"building batch={batch_size} remat={remat}")
-    state, step, batch, rng = build(batch_size, remat)
+def force(state, metrics):
+    """Host-side value fetch that transitively depends on the whole step.
+
+    float() must produce real bytes, so it waits for actual execution —
+    unlike block_until_ready, which the axon remote backend answers early.
+    """
+    loss = float(jax.device_get(metrics["loss"]))
+    leaf = jax.tree_util.tree_leaves(state.params)[0]
+    probe = float(jax.device_get(leaf.ravel()[0]))
+    return loss, probe
+
+
+def run(batch_size, remat, warmup, steps, corr_impl=None):
+    warmup, steps = max(1, warmup), max(1, steps)  # force() needs metrics
+    log(f"building batch={batch_size} remat={remat} corr_impl={corr_impl}")
+    state, step, batch, rng = build(batch_size, remat, corr_impl)
     log("compiling + warmup")
     for _ in range(warmup):
         state, metrics = step(state, batch, rng)
-        jax.block_until_ready(metrics)
-    log("timing (blocked per step)")
-    times = []
+    loss, _ = force(state, metrics)
+    log(f"warmup done, loss={loss:.3f}; timing {steps} chained steps")
+    t0 = time.perf_counter()
     for _ in range(steps):
-        t0 = time.perf_counter()
         state, metrics = step(state, batch, rng)
-        jax.block_until_ready(metrics)
-        times.append(time.perf_counter() - t0)
-    med = float(np.median(times))
-    log(f"per-step times: min={min(times):.3f} med={med:.3f} "
-        f"max={max(times):.3f}")
-    return batch_size / med
+    loss, _ = force(state, metrics)     # waits for the full chain
+    dt = (time.perf_counter() - t0) / steps
+    log(f"avg step {dt * 1e3:.1f} ms over {steps} steps (value-fetch "
+        f"fenced), final loss={loss:.3f}")
+    return batch_size / dt
 
 
 def emit(metric, value):
@@ -116,9 +134,11 @@ def main():
     p.add_argument("--batches", type=int, nargs="+", default=[6, 4, 2])
     p.add_argument("--remat", action="store_true")
     p.add_argument("--warmup", type=int, default=2)
-    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--steps", type=int, default=20)
     p.add_argument("--deadline-s", type=float, default=2400.0,
                    help="no new attempt starts after this wall-clock budget")
+    p.add_argument("--corr-impl", default=None,
+                   help="override RAFTConfig.corr_impl (gather/onehot/pallas)")
     args = p.parse_args()
 
     try:
@@ -135,7 +155,8 @@ def main():
             log("deadline reached before attempt")
             break
         try:
-            value = run(batch_size, args.remat, args.warmup, args.steps)
+            value = run(batch_size, args.remat, args.warmup, args.steps,
+                        args.corr_impl)
         except Exception as exc:
             last_err = exc
             if is_oom(exc):
@@ -144,6 +165,8 @@ def main():
             log(f"fatal (non-OOM): {type(exc).__name__}: {exc}")
             break
         tag = "_remat" if args.remat else ""
+        if args.corr_impl:
+            tag += f"_{args.corr_impl}"
         emit(f"raft_basic_train_chairs_368x496_bf16_b{batch_size}"
              f"_iters{ITERS}_1chip{tag}", value)
         return 0

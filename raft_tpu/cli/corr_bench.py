@@ -5,7 +5,8 @@ diff + wall-clock with explicit fences) applied to the corr-lookup backends:
 
 - ``gather``: flattened-index 4-corner take_along_axis (XLA)
 - ``onehot``: one-hot window GEMMs on the MXU (XLA)
-- ``pallas``: double-buffered window-DMA kernel (TPU only)
+- ``pallas``: block-pipelined mask-select kernel (TPU only; see
+  ``kernels/corr_pallas.py`` for the design and its measured history)
 - ``alt``:    on-the-fly blockwise correlation (alt_cuda_corr analog)
 
 Run on the real chip:  python -m raft_tpu.cli.corr_bench --hw 46 62
@@ -24,18 +25,47 @@ import jax
 import jax.numpy as jnp
 
 
-def bench_fn(fn, args, warmup=2, iters=20):
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
+def _fetch(out):
+    """Host value fetch — the only honest fence on the remote axon backend
+    (block_until_ready returns before execution completes there)."""
+    return jax.tree_util.tree_map(np.asarray, out)
+
+
+def bench_fn(fn, args, iters=20):
+    """Time ``iters`` applications of ``fn`` inside ONE executable.
+
+    Two measurement hazards on the remote axon backend, both learned the
+    hard way: (a) block_until_ready returns before execution finishes, so
+    only a host-side value fetch fences — but (b) fetching a full-sized
+    output pays D2H over the tunnel (~100 MB/s), dwarfing kernel time.
+    So: run the loop as a lax.scan inside one jit — each iteration's input
+    is nudged by a term derived from the previous output, which defeats
+    loop-invariant hoisting/CSE — and fetch a single scalar at the end.
+    """
+    (coords,) = args
+
+    def step(c, _):
+        out = fn(c)
+        # consume EVERY output leaf: a nudge that only reads the primal
+        # would let XLA dead-code-eliminate the whole backward pass in
+        # --grad mode (the sums add one pyramid-sized reduce per iteration
+        # — bounded noise next to the kernels being measured)
+        probe = sum(jnp.sum(leaf) for leaf in jax.tree_util.tree_leaves(out))
+        return c + (probe * 1e-12).astype(c.dtype), ()
+
+    scanned = jax.jit(
+        lambda c: jnp.ravel(jax.lax.scan(step, c, None, length=iters)[0])[0])
+    out = _fetch(fn(coords))          # parity output (not timed)
+    float(scanned(coords))            # compile + warm (not timed)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
+    float(scanned(coords))            # scalar fetch: waits for all iters
     return (time.perf_counter() - t0) / iters, out
 
 
 def main(argv=None):
+    from raft_tpu.utils.platform import respect_cpu_request
+
+    respect_cpu_request()
     p = argparse.ArgumentParser(description="corr lookup backend shootout")
     p.add_argument("--batch", type=int, default=6)
     p.add_argument("--hw", type=int, nargs=2, default=[46, 62],
@@ -46,9 +76,13 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--impls", nargs="+",
                    default=["gather", "onehot", "pallas", "alt"])
+    p.add_argument("--grad", action="store_true",
+                   help="bench value+grad (the train-step cost) instead of "
+                        "forward only")
     args = p.parse_args(argv)
 
-    from raft_tpu.kernels import corr_lookup_pallas, pallas_available
+    from raft_tpu.kernels import (corr_lookup_pallas, pad_pyramid,
+                                  pallas_available)
     from raft_tpu.models.corr import (alt_corr_lookup, build_corr_pyramid,
                                       corr_lookup, corr_lookup_onehot)
     from raft_tpu.ops.pooling import avg_pool2x2
@@ -63,20 +97,51 @@ def main(argv=None):
 
     pyramid = jax.block_until_ready(
         tuple(build_corr_pyramid(fmap1, fmap2, args.levels)))
+    # the model pads once OUTSIDE the refinement loop (raft.py wires
+    # prepadded=True); bench the same configuration
+    pyramid_pp = jax.block_until_ready(
+        tuple(pad_pyramid(pyramid, args.radius)))
     f2_pyr = [fmap2]
     for _ in range(args.levels - 1):
         f2_pyr.append(avg_pool2x2(f2_pyr[-1]))
     f2_pyr = jax.block_until_ready(tuple(f2_pyr))
 
-    lookups = {
-        "gather": jax.jit(lambda c: corr_lookup(pyramid, c, args.radius)),
-        "onehot": jax.jit(
-            lambda c: corr_lookup_onehot(pyramid, c, args.radius)),
-        "pallas": jax.jit(
-            lambda c: corr_lookup_pallas(pyramid, c, args.radius)),
-        "alt": jax.jit(
-            lambda c: alt_corr_lookup(fmap1, f2_pyr, c, args.radius)),
+    PAD = 2 * args.radius + 3  # pad_pyramid margin (kernels/corr_pallas.py)
+
+    def unpad_grads(d_pp):
+        """Padded-pyramid cotangents -> unpadded layout (adjoint of pad)."""
+        return tuple(
+            d[:, :v.shape[1], PAD:PAD + v.shape[2], PAD:PAD + v.shape[3]]
+            for d, v in zip(d_pp, pyramid))
+
+    # per impl: (volume input to differentiate, lookup fn, grad postprocess)
+    impls = {
+        "gather": (pyramid,
+                   lambda v, c: corr_lookup(v, c, args.radius), None),
+        "onehot": (pyramid,
+                   lambda v, c: corr_lookup_onehot(v, c, args.radius), None),
+        "pallas": (pyramid_pp,
+                   lambda v, c: corr_lookup_pallas(
+                       v, c, args.radius, prepadded=True), unpad_grads),
+        "alt": ((fmap1, f2_pyr),
+                lambda v, c: alt_corr_lookup(v[0], v[1], c, args.radius),
+                None),
     }
+
+    lookups = {}
+    for name, (vols, fn, post) in impls.items():
+        if args.grad:
+            # Training cost: grads flow into the corr volume / fmaps (coords
+            # are stop_gradient'ed each refinement iteration, raft.py loop),
+            # so differentiate w.r.t. the volume inputs, not coords.
+            def run(c, _vols=vols, _fn=fn, _post=post):
+                val, d = jax.value_and_grad(
+                    lambda v: jnp.sum(_fn(v, c) ** 2))(_vols)
+                return val, (_post(d) if _post else d)
+        else:
+            def run(c, _vols=vols, _fn=fn):
+                return _fn(_vols, c)
+        lookups[name] = jax.jit(run)
 
     reference = None
     results = {}
@@ -89,12 +154,24 @@ def main(argv=None):
         except Exception as e:
             print(f"{name:>8}: FAILED {type(e).__name__}: {e}")
             continue
-        out = np.asarray(out)
+        # comparable output: the lookup itself, or — in grad mode — the
+        # sum-of-squares primal plus every gradient leaf, flattened (a
+        # wrong backward must not hide behind a correct forward). Note
+        # 'alt' differentiates the fmaps instead of the volume, so its
+        # grad-mode diff vs the volume-based impls is structural, not a bug.
+        if args.grad:
+            val, grads = out
+            cmp = np.concatenate(
+                [np.ravel(val)]
+                + [np.ravel(l) for l in jax.tree_util.tree_leaves(grads)])
+        else:
+            cmp = np.asarray(out)
         if reference is None:
-            reference = out
+            reference = cmp
             diff = 0.0
         else:
-            diff = float(np.abs(out - reference).max())
+            denom = max(float(np.abs(reference).max()), 1e-9) if args.grad else 1.0
+            diff = float(np.abs(cmp - reference).max()) / denom
         results[name] = dt
         queries_per_s = B * H * W / dt
         print(f"{name:>8}: {dt * 1e3:8.3f} ms  "

@@ -1,0 +1,88 @@
+"""Chip/toolchain envelope sanity: matmul peak, HBM BW, dispatch overhead.
+
+Calibrates every other benchmark in this repo against the hardware's
+physical limits (v5e-1: ~197 TFLOP/s bf16, ~819 GB/s HBM). If these numbers
+are far off, the environment — not the model code — is the story. Timing
+uses the loop-inside-one-executable scheme from BENCH_NOTES.md (the remote
+axon backend's ``block_until_ready`` returns early, and fetching large
+outputs pays tunnel D2H at ~100 MB/s).
+
+Run on the chip:  python -m raft_tpu.cli.envelope
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def scan_time(name, body, x0, iters=20, work=None, unit="T/s"):
+    """Time ``iters`` chained applications of ``body`` in one executable."""
+
+    def step(c, _):
+        out = body(c)
+        return c + (jnp.mean(out) * 1e-12).astype(c.dtype), ()
+
+    f = jax.jit(
+        lambda c: jnp.ravel(jax.lax.scan(step, c, None, length=iters)[0])[0])
+    float(f(x0))                      # compile + warm
+    t0 = time.perf_counter()
+    float(f(x0))                      # scalar fetch fences all iterations
+    dt = (time.perf_counter() - t0) / iters
+    extra = f"  {work / dt / 1e12:.2f} {unit}" if work else ""
+    print(f"{name}: {dt * 1e3:.3f} ms{extra}", flush=True)
+    return dt
+
+
+def main(argv=None):
+    from raft_tpu.utils.platform import respect_cpu_request
+
+    respect_cpu_request()
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args(argv)
+
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/raft_tpu_jax_cache_tpu")
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(8192, 8192).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+    scan_time("matmul 8192^3 bf16 (peak ~197 TFLOP/s)",
+              lambda x: (x @ x).astype(jnp.bfloat16), a,
+              iters=args.iters, work=2 * 8192**3, unit="TFLOP/s")
+
+    big = jnp.asarray(rng.randn(64, 1024, 1024).astype(np.float32))  # 256 MB
+    scan_time("elementwise +1 on 256MB (512MB traffic, peak ~0.8 TB/s)",
+              lambda x: x + 1.0, big,
+              iters=args.iters, work=512 * 2**20, unit="TB/s")
+    scan_time("pad+unpad 256MB by 11px",
+              lambda x: jnp.pad(
+                  x, ((0, 0), (11, 11), (11, 11)))[:, 11:-11, 11:-11],
+              big, iters=args.iters)
+    scan_time("tiny op in-scan floor", lambda x: x * 2.0,
+              jnp.zeros((8, 128), jnp.float32), iters=100)
+
+    # dispatch overhead: the same tiny op as separate executable launches
+    tiny = jnp.zeros((8, 128), jnp.float32)
+    tf = jax.jit(lambda x: x * 2.0 + jnp.sum(x) * 1e-12)
+    float(jnp.ravel(tf(tiny))[0])
+    t0 = time.perf_counter()
+    x = tiny
+    for _ in range(50):
+        x = tf(x)
+    float(jnp.ravel(x)[0])
+    print(f"per-dispatch overhead (chained separate calls): "
+          f"{(time.perf_counter() - t0) / 50 * 1e3:.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

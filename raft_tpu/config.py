@@ -31,9 +31,16 @@ class RAFTConfig:
     mixed_precision: bool = False
     corr_levels: int = 4
     # lookup backend for the materialized pyramid: 'gather' (flattened-index
-    # take), 'onehot' (MXU one-hot GEMMs), or 'pallas' (window-DMA kernel,
-    # TPU only). Benchmark with `python -m raft_tpu.cli.corr_bench`.
-    corr_impl: str = "gather"
+    # take), 'onehot' (one-hot selection GEMMs), or 'pallas' (vectorized
+    # mask-select kernel, TPU only). Default: 'onehot', on partial on-chip
+    # evidence (BENCH_NOTES.md, v5e-1 chairs geometry): gather measured
+    # 364 ms fwd and a disqualifying 3967 ms fwd+grad per lookup (TPU
+    # scatter lowering); onehot measured 170 ms fwd, its backward is the
+    # transpose of the same GEMMs (same cost class, not yet measured on
+    # chip — the tunnel dropped first). Re-benchmark with
+    # `python -m raft_tpu.cli.corr_bench` (+ --grad); 'pallas' may take
+    # over once its backward is validated on hardware.
+    corr_impl: str = "onehot"
     # rematerialize the refinement-iteration body in the backward pass:
     # trades ~30% recompute for dropping the per-iteration activation stack
     # (observed ~1.5 GB/buffer at chairs shapes), the jax.checkpoint lever

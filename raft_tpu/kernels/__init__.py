@@ -6,6 +6,7 @@ Pallas kernels; selection between XLA paths and Pallas is a config knob
 (``RAFTConfig.corr_impl``) benchmarked by ``raft_tpu.cli.corr_bench``.
 """
 
-from raft_tpu.kernels.corr_pallas import corr_lookup_pallas, pallas_available
+from raft_tpu.kernels.corr_pallas import (corr_lookup_pallas, pad_pyramid,
+                                          pallas_available)
 
-__all__ = ["corr_lookup_pallas", "pallas_available"]
+__all__ = ["corr_lookup_pallas", "pad_pyramid", "pallas_available"]

@@ -99,16 +99,22 @@ class RAFT(nn.Module):
         else:
             corr_state = tuple(
                 build_corr_pyramid(fmap1, fmap2, cfg.corr_levels))
-            if cfg.corr_impl == "onehot":
-                lookup_fn = corr_lookup_onehot
-            elif cfg.corr_impl == "pallas":
-                from raft_tpu.kernels import corr_lookup_pallas
-                lookup_fn = corr_lookup_pallas
-            else:
-                lookup_fn = corr_lookup
+            if cfg.corr_impl == "pallas":
+                from raft_tpu.kernels import corr_lookup_pallas, pad_pyramid
 
-            def lookup(state, coords):
-                return lookup_fn(state, coords, cfg.corr_radius)
+                # pad once, outside the scanned loop (the pyramid is
+                # nn.broadcast — loop-invariant)
+                corr_state = pad_pyramid(corr_state, cfg.corr_radius)
+
+                def lookup(state, coords):
+                    return corr_lookup_pallas(state, coords, cfg.corr_radius,
+                                              prepadded=True)
+            else:
+                lookup_fn = (corr_lookup_onehot if cfg.corr_impl == "onehot"
+                             else corr_lookup)
+
+                def lookup(state, coords):
+                    return lookup_fn(state, coords, cfg.corr_radius)
 
         # context network (core/raft.py:110-114)
         cnet = self.cnet(image1, train=train, use_running_average=ura)

@@ -1,0 +1,27 @@
+"""Backend-selection guard for CLIs.
+
+The image's sitecustomize registers the 'axon' remote-TPU PJRT plugin in
+every interpreter, and jax initializes it even under ``JAX_PLATFORMS=cpu``
+— dialing (and, when the tunnel is down, blocking ~25 min on) the
+single-chip relay. When the user explicitly asked for CPU, deregister the
+factory BEFORE any backend initialization so CPU runs never touch the
+tunnel. Same guard as tests/conftest.py and __graft_entry__.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def respect_cpu_request() -> None:
+    """If JAX_PLATFORMS=cpu, make sure the axon plugin can't be dialed."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        return
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - best effort
+        pass

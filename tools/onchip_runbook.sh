@@ -1,0 +1,33 @@
+#!/bin/bash
+# On-chip measurement runbook — run when the axon tunnel is up.
+# Executes everything "owed to the hardware" (BENCH_NOTES.md) in priority
+# order, appending to /tmp/onchip_runbook.out. Each step is independently
+# useful; a tunnel drop mid-way loses only the remaining steps.
+set -u
+cd /root/repo
+OUT=${1:-/tmp/onchip_runbook.out}
+log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
+
+log "0 envelope"
+timeout 2400 python -m raft_tpu.cli.envelope >> "$OUT" 2>&1
+
+log "1 corr_bench chairs fwd"
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 --iters 20 >> "$OUT" 2>&1
+log "2 corr_bench chairs grad"
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 --iters 20 --grad >> "$OUT" 2>&1
+
+log "3 bench.py corr-impl shootout (winner becomes default)"
+timeout 2400 python bench.py --steps 10 --corr-impl pallas >> "$OUT" 2>&1
+timeout 2400 python bench.py --steps 10 --corr-impl onehot >> "$OUT" 2>&1
+
+log "4 corr_bench 128x128 fwd+grad"
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 1 --hw 128 128 --iters 10 >> "$OUT" 2>&1
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 1 --hw 128 128 --iters 10 --grad >> "$OUT" 2>&1
+
+log "5 profile_step trace"
+timeout 2400 python -m raft_tpu.cli.profile_step --batch 6 --steps 10 --corr-impl pallas --trace-dir /tmp/raft_trace >> "$OUT" 2>&1
+
+log "6 bench.py batch ladder with winner (edit default first if clear)"
+timeout 2400 python bench.py --steps 10 --batches 8 6 --corr-impl pallas >> "$OUT" 2>&1
+
+log "done"
