@@ -89,31 +89,20 @@ def build(batch_size, remat, corr_impl=None):
     return state, step, batch, rng
 
 
-def force(state, metrics):
-    """Host-side value fetch that transitively depends on the whole step.
-
-    float() must produce real bytes, so it waits for actual execution —
-    unlike block_until_ready, which the axon remote backend answers early.
-    """
-    loss = float(jax.device_get(metrics["loss"]))
-    leaf = jax.tree_util.tree_leaves(state.params)[0]
-    probe = float(jax.device_get(leaf.ravel()[0]))
-    return loss, probe
-
-
 def run(batch_size, remat, warmup, steps, corr_impl=None):
+    from raft_tpu.utils.timing import force_train as force
     warmup, steps = max(1, warmup), max(1, steps)  # force() needs metrics
     log(f"building batch={batch_size} remat={remat} corr_impl={corr_impl}")
     state, step, batch, rng = build(batch_size, remat, corr_impl)
     log("compiling + warmup")
     for _ in range(warmup):
         state, metrics = step(state, batch, rng)
-    loss, _ = force(state, metrics)
+    loss = force(state, metrics)
     log(f"warmup done, loss={loss:.3f}; timing {steps} chained steps")
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch, rng)
-    loss, _ = force(state, metrics)     # waits for the full chain
+    loss = force(state, metrics)     # waits for the full chain
     dt = (time.perf_counter() - t0) / steps
     log(f"avg step {dt * 1e3:.1f} ms over {steps} steps (value-fetch "
         f"fenced), final loss={loss:.3f}")

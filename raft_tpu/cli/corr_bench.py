@@ -17,7 +17,6 @@ max envelope).
 from __future__ import annotations
 
 import argparse
-import time
 
 import numpy as np
 
@@ -25,41 +24,15 @@ import jax
 import jax.numpy as jnp
 
 
-def _fetch(out):
-    """Host value fetch — the only honest fence on the remote axon backend
-    (block_until_ready returns before execution completes there)."""
-    return jax.tree_util.tree_map(np.asarray, out)
-
-
 def bench_fn(fn, args, iters=20):
-    """Time ``iters`` applications of ``fn`` inside ONE executable.
+    """Time ``iters`` applications of ``fn`` inside ONE executable (see
+    raft_tpu/utils/timing.py for the remote-backend fencing scheme) and
+    return (seconds/iter, one full output for parity comparison)."""
+    from raft_tpu.utils.timing import chain_timed
 
-    Two measurement hazards on the remote axon backend, both learned the
-    hard way: (a) block_until_ready returns before execution finishes, so
-    only a host-side value fetch fences — but (b) fetching a full-sized
-    output pays D2H over the tunnel (~100 MB/s), dwarfing kernel time.
-    So: run the loop as a lax.scan inside one jit — each iteration's input
-    is nudged by a term derived from the previous output, which defeats
-    loop-invariant hoisting/CSE — and fetch a single scalar at the end.
-    """
     (coords,) = args
-
-    def step(c, _):
-        out = fn(c)
-        # consume EVERY output leaf: a nudge that only reads the primal
-        # would let XLA dead-code-eliminate the whole backward pass in
-        # --grad mode (the sums add one pyramid-sized reduce per iteration
-        # — bounded noise next to the kernels being measured)
-        probe = sum(jnp.sum(leaf) for leaf in jax.tree_util.tree_leaves(out))
-        return c + (probe * 1e-12).astype(c.dtype), ()
-
-    scanned = jax.jit(
-        lambda c: jnp.ravel(jax.lax.scan(step, c, None, length=iters)[0])[0])
-    out = _fetch(fn(coords))          # parity output (not timed)
-    float(scanned(coords))            # compile + warm (not timed)
-    t0 = time.perf_counter()
-    float(scanned(coords))            # scalar fetch: waits for all iters
-    return (time.perf_counter() - t0) / iters, out
+    out = jax.tree_util.tree_map(np.asarray, fn(coords))  # parity, untimed
+    return chain_timed(fn, coords, iters), out
 
 
 def main(argv=None):
@@ -106,7 +79,9 @@ def main(argv=None):
         f2_pyr.append(avg_pool2x2(f2_pyr[-1]))
     f2_pyr = jax.block_until_ready(tuple(f2_pyr))
 
-    PAD = 2 * args.radius + 3  # pad_pyramid margin (kernels/corr_pallas.py)
+    from raft_tpu.kernels.corr_pallas import _pad
+
+    PAD = _pad(args.radius)  # pad_pyramid margin, single source of truth
 
     def unpad_grads(d_pp):
         """Padded-pyramid cotangents -> unpadded layout (adjoint of pad)."""
@@ -168,14 +143,20 @@ def main(argv=None):
             cmp = np.asarray(out)
         if reference is None:
             reference = cmp
-            diff = 0.0
+            diff = "max|Δ|=0.00e+00"
+        elif cmp.shape != reference.shape:
+            # 'alt' differentiates (fmap1, f2_pyr) while the volume impls
+            # differentiate the pyramid — gradient vectors aren't
+            # comparable across that boundary
+            diff = "Δ=n/a (different grad structure)"
         else:
-            denom = max(float(np.abs(reference).max()), 1e-9) if args.grad else 1.0
-            diff = float(np.abs(cmp - reference).max()) / denom
+            denom = (max(float(np.abs(reference).max()), 1e-9)
+                     if args.grad else 1.0)
+            diff = f"max|Δ|={float(np.abs(cmp - reference).max()) / denom:.2e}"
         results[name] = dt
         queries_per_s = B * H * W / dt
         print(f"{name:>8}: {dt * 1e3:8.3f} ms  "
-              f"{queries_per_s / 1e6:8.2f} Mquery/s  max|Δ|={diff:.2e}")
+              f"{queries_per_s / 1e6:8.2f} Mquery/s  {diff}")
 
     if results:
         fastest = min(results, key=results.get)

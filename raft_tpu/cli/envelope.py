@@ -22,18 +22,11 @@ import jax.numpy as jnp
 
 
 def scan_time(name, body, x0, iters=20, work=None, unit="T/s"):
-    """Time ``iters`` chained applications of ``body`` in one executable."""
+    """Time ``iters`` chained applications of ``body`` in one executable
+    (fencing scheme: raft_tpu/utils/timing.py)."""
+    from raft_tpu.utils.timing import chain_timed
 
-    def step(c, _):
-        out = body(c)
-        return c + (jnp.mean(out) * 1e-12).astype(c.dtype), ()
-
-    f = jax.jit(
-        lambda c: jnp.ravel(jax.lax.scan(step, c, None, length=iters)[0])[0])
-    float(f(x0))                      # compile + warm
-    t0 = time.perf_counter()
-    float(f(x0))                      # scalar fetch fences all iterations
-    dt = (time.perf_counter() - t0) / iters
+    dt = chain_timed(body, x0, iters)
     extra = f"  {work / dt / 1e12:.2f} {unit}" if work else ""
     print(f"{name}: {dt * 1e3:.3f} ms{extra}", flush=True)
     return dt

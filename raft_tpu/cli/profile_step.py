@@ -104,16 +104,9 @@ def main(argv=None):
     except Exception as e:
         print(f"memory_analysis unavailable: {e}")
 
-    # NOTE on fencing: on the remote 'axon' backend block_until_ready
-    # returns before execution finishes (measured: "1.7 ms/step" = 1013
-    # TFLOP/s on a 197 TFLOP/s chip). Only a host-side value fetch is an
-    # honest fence, so timing runs a chained loop (each step consumes the
-    # donated previous state) and float()s the final loss + a param leaf.
-    def force(state, metrics):
-        loss = float(jax.device_get(metrics["loss"]))
-        leaf = jax.tree_util.tree_leaves(state.params)[0]
-        float(jax.device_get(leaf.ravel()[0]))
-        return loss
+    # fencing scheme: raft_tpu/utils/timing.py (block_until_ready lies on
+    # the remote backend; time a chained loop, fetch scalars only)
+    from raft_tpu.utils.timing import force_train as force
 
     t0 = time.perf_counter()
     for _ in range(args.warmup):

@@ -1,0 +1,55 @@
+"""Honest timing on the remote 'axon' TPU backend — the ONE place the
+scheme lives (BENCH_NOTES.md documents the three wrong schemes that
+preceded it; keep them dead).
+
+Hazards this module encodes:
+
+- ``jax.block_until_ready`` returns before execution finishes on the
+  remote backend (measured 5× above chip peak) — only a host-side value
+  fetch fences.
+- Fetching a full-sized output pays D2H over the tunnel at ~100 MB/s,
+  dwarfing kernel time — fetch scalars only.
+- Timing a loop of separate dispatches measures dispatch; run the loop
+  inside ONE executable, chained through a data dependency so XLA cannot
+  hoist the loop-invariant body or dead-code-eliminate any output.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def chain_timed(fn: Callable, x0: jax.Array, iters: int) -> float:
+    """Seconds per application of ``fn``, measured inside one executable.
+
+    ``fn(x)`` may return any pytree; EVERY leaf is consumed by the
+    chaining nudge (a backward pass inside ``fn`` must not be eliminable).
+    Returns seconds/iteration; one compile+warm call runs first.
+    """
+
+    def step(c, _):
+        out = fn(c)
+        probe = sum(jnp.sum(leaf)
+                    for leaf in jax.tree_util.tree_leaves(out))
+        return c + (probe * 1e-12).astype(c.dtype), ()
+
+    scanned = jax.jit(
+        lambda c: jnp.ravel(jax.lax.scan(step, c, None, length=iters)[0])[0])
+    float(scanned(x0))                  # compile + warm (not timed)
+    t0 = time.perf_counter()
+    float(scanned(x0))                  # scalar fetch fences all iterations
+    return (time.perf_counter() - t0) / iters
+
+
+def force_train(state, metrics) -> float:
+    """Fence a chained train-step loop: fetch the loss and one param leaf
+    of the final state (both transitively depend on every step when state
+    is threaded/donated). Returns the loss value."""
+    loss = float(jax.device_get(metrics["loss"]))
+    leaf = jax.tree_util.tree_leaves(state.params)[0]
+    float(jax.device_get(leaf.ravel()[0]))
+    return loss

@@ -1,0 +1,69 @@
+"""The corr_bench CLI is measurement infrastructure (it picks the model's
+corr_impl default from hardware runs), so its plumbing is tested like
+product code: every impl path in both modes, including the Pallas kernel in
+interpret mode and the padded-pyramid gradient unpad in --grad mode.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import raft_tpu.kernels as kernels
+from raft_tpu.cli import corr_bench
+from raft_tpu.kernels import corr_pallas
+
+
+@pytest.fixture(autouse=True)
+def interpret_pallas(monkeypatch):
+    monkeypatch.setattr(corr_pallas, "_INTERPRET", True)
+    # pallas_available() gates on a real TPU backend; interpret mode runs
+    # the same program on CPU (main() imports it from raft_tpu.kernels)
+    monkeypatch.setattr(kernels, "pallas_available", lambda: True)
+
+
+ARGS = ["--batch", "1", "--hw", "8", "12", "--dim", "16", "--radius", "2",
+        "--levels", "2", "--iters", "2"]
+
+
+def _diffs(capsys):
+    out = capsys.readouterr().out
+    return out, [float(line.split("max|Δ|=")[1])
+                 for line in out.splitlines() if "max|Δ|" in line]
+
+
+def test_forward_all_impls(capsys):
+    results = corr_bench.main(
+        ARGS + ["--impls", "gather", "onehot", "pallas", "alt"])
+    assert set(results) == {"gather", "onehot", "pallas", "alt"}
+    out, diffs = _diffs(capsys)
+    assert len(diffs) == 4 and max(diffs) < 1e-4, out
+
+
+def test_grad_mode_parity_includes_gradients(capsys):
+    """Grad-mode parity compares gradient leaves, not just the primal —
+    a wrong backward (e.g. in the Pallas scatter kernel or its unpad
+    slicing) must surface as a large max|Δ| here."""
+    results = corr_bench.main(
+        ARGS + ["--grad", "--impls", "gather", "onehot", "pallas"])
+    assert set(results) == {"gather", "onehot", "pallas"}
+    out, diffs = _diffs(capsys)
+    assert len(diffs) == 3 and max(diffs) < 1e-4, out
+
+
+def test_grad_mode_flags_a_broken_backward(capsys):
+    """If the Pallas VJP returned zeros, parity must catch it (guards the
+    failure mode where only the primal would be compared and a broken
+    backward would silently win the shootout)."""
+
+    def zero_bwd(radius, res, g):
+        d_pyramid, dx, dy = corr_pallas._lookup_bwd(radius, res, g)
+        return tuple(jnp.zeros_like(d) for d in d_pyramid), dx, dy
+
+    corr_pallas._lookup.defvjp(corr_pallas._lookup_fwd, zero_bwd)
+    try:
+        corr_bench.main(ARGS + ["--grad", "--impls", "gather", "pallas"])
+        out, diffs = _diffs(capsys)
+        assert max(diffs) > 1e-3, f"zeroed backward not detected: {out}"
+    finally:
+        corr_pallas._lookup.defvjp(corr_pallas._lookup_fwd,
+                                   corr_pallas._lookup_bwd)
