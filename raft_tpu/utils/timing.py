@@ -23,12 +23,15 @@ import jax
 import jax.numpy as jnp
 
 
-def chain_timed(fn: Callable, x0: jax.Array, iters: int) -> float:
-    """Seconds per application of ``fn``, measured inside one executable.
+def chained_scan(fn: Callable, iters: int) -> Callable:
+    """The timed executable: ``iters`` applications of ``fn`` chained
+    through an output-derived input nudge, returning one scalar.
 
-    ``fn(x)`` may return any pytree; EVERY leaf is consumed by the
-    chaining nudge (a backward pass inside ``fn`` must not be eliminable).
-    Returns seconds/iteration; one compile+warm call runs first.
+    The nudge consumes EVERY output leaf, so nothing inside ``fn`` — in
+    particular a backward pass in a value_and_grad — is dead code, and the
+    loop-invariant body cannot be hoisted out of the scan. Exposed
+    separately from :func:`chain_timed` so tests can inspect the compiled
+    HLO for exactly this property.
     """
 
     def step(c, _):
@@ -37,8 +40,17 @@ def chain_timed(fn: Callable, x0: jax.Array, iters: int) -> float:
                     for leaf in jax.tree_util.tree_leaves(out))
         return c + (probe * 1e-12).astype(c.dtype), ()
 
-    scanned = jax.jit(
+    return jax.jit(
         lambda c: jnp.ravel(jax.lax.scan(step, c, None, length=iters)[0])[0])
+
+
+def chain_timed(fn: Callable, x0: jax.Array, iters: int) -> float:
+    """Seconds per application of ``fn``, measured inside one executable.
+
+    ``fn(x)`` may return any pytree. Returns seconds/iteration; one
+    compile+warm call runs first.
+    """
+    scanned = chained_scan(fn, iters)
     float(scanned(x0))                  # compile + warm (not timed)
     t0 = time.perf_counter()
     float(scanned(x0))                  # scalar fetch fences all iterations

@@ -1,0 +1,70 @@
+"""The timing harness is the foundation of every performance number this
+repo reports (BENCH_NOTES.md documents the three wrong schemes it
+replaced), so its anti-dead-code property is pinned at the HLO level: a
+backward pass inside the timed function must survive XLA optimization.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.timing import chain_timed, chained_scan
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _marked(x):
+    return x * 2.0
+
+
+def _marked_fwd(x):
+    return x * 2.0, x
+
+
+def _marked_bwd(res, g):
+    # 'atan2' is distinctive and survives into optimized HLO by name; it
+    # appears nowhere else in the scanned program
+    return (g * jnp.arctan2(res, res + 1.0),)
+
+
+_marked.defvjp(_marked_fwd, _marked_bwd)
+
+
+def _grad_fn(x):
+    return jax.value_and_grad(lambda v: jnp.sum(_marked(v) ** 2))(x)
+
+
+def test_backward_survives_in_compiled_hlo():
+    x = jnp.ones((4, 8), jnp.float32)
+    scanned = chained_scan(_grad_fn, iters=3)
+    hlo = scanned.lower(x).compile().as_text()
+    assert "atan2" in hlo, (
+        "backward pass was dead-code-eliminated from the timed scan — "
+        "grad-mode timings would silently measure forward only")
+
+
+def test_primal_only_nudge_would_fail():
+    """Counter-test: the naive scheme (nudge from the primal leaf only)
+    really does lose the backward — guards against someone 'simplifying'
+    chained_scan back to it."""
+    x = jnp.ones((4, 8), jnp.float32)
+
+    def step(c, _):
+        val, _grads = _grad_fn(c)
+        return c + (jnp.mean(val) * 1e-12).astype(c.dtype), ()
+
+    naive = jax.jit(
+        lambda c: jnp.ravel(jax.lax.scan(step, c, None, length=3)[0])[0])
+    hlo = naive.lower(x).compile().as_text()
+    assert "atan2" not in hlo, (
+        "XLA stopped eliminating the unused backward; the counter-test "
+        "no longer demonstrates the hazard (harmless, but re-check "
+        "chained_scan's rationale)")
+
+
+def test_chain_timed_runs_and_returns_positive():
+    dt = chain_timed(lambda x: x * 1.5, jnp.ones((8, 8), jnp.float32),
+                     iters=2)
+    assert dt > 0.0 and np.isfinite(dt)
