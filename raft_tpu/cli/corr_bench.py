@@ -7,7 +7,9 @@ diff + wall-clock with explicit fences) applied to the corr-lookup backends:
 - ``onehot``: one-hot window GEMMs on the MXU (XLA)
 - ``pallas``: block-pipelined mask-select kernel (TPU only; see
   ``kernels/corr_pallas.py`` for the design and its measured history)
-- ``alt``:    on-the-fly blockwise correlation (alt_cuda_corr analog)
+- ``alt``:    on-the-fly blockwise correlation (alt_cuda_corr analog, XLA)
+- ``alt_pallas``: on-the-fly windowed correlation, window-DMA-ring Pallas
+  kernel (``kernels/corr_alt_pallas.py``; TPU only)
 
 Run on the real chip:  python -m raft_tpu.cli.corr_bench --hw 46 62
 (46x62 = the 368x496 chairs crop at stride 8; use 128 128 for the KITTI/TRT
@@ -48,13 +50,15 @@ def main(argv=None):
     p.add_argument("--levels", type=int, default=4)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--impls", nargs="+",
-                   default=["gather", "onehot", "pallas", "alt"])
+                   default=["gather", "onehot", "pallas", "alt",
+                            "alt_pallas"])
     p.add_argument("--grad", action="store_true",
                    help="bench value+grad (the train-step cost) instead of "
                         "forward only")
     args = p.parse_args(argv)
 
-    from raft_tpu.kernels import (corr_lookup_pallas, pad_pyramid,
+    from raft_tpu.kernels import (alt_corr_lookup_pallas, corr_lookup_pallas,
+                                  pad_f2_pyramid, pad_pyramid,
                                   pallas_available)
     from raft_tpu.models.corr import (alt_corr_lookup, build_corr_pyramid,
                                       corr_lookup, corr_lookup_onehot)
@@ -101,6 +105,11 @@ def main(argv=None):
         "alt": ((fmap1, f2_pyr),
                 lambda v, c: alt_corr_lookup(v[0], v[1], c, args.radius),
                 None),
+        "alt_pallas": ((fmap1, jax.block_until_ready(
+                            tuple(pad_f2_pyramid(f2_pyr, args.radius)))),
+                       lambda v, c: alt_corr_lookup_pallas(
+                           v[0], v[1], c, args.radius, prepadded=True),
+                       None),
     }
 
     lookups = {}
@@ -121,7 +130,7 @@ def main(argv=None):
     reference = None
     results = {}
     for name in args.impls:
-        if name == "pallas" and not pallas_available():
+        if name in ("pallas", "alt_pallas") and not pallas_available():
             print(f"{name:>8}: skipped (no TPU backend)")
             continue
         try:

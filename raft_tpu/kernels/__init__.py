@@ -6,7 +6,10 @@ Pallas kernels; selection between XLA paths and Pallas is a config knob
 (``RAFTConfig.corr_impl``) benchmarked by ``raft_tpu.cli.corr_bench``.
 """
 
+from raft_tpu.kernels.corr_alt_pallas import (alt_corr_lookup_pallas,
+                                              pad_f2_pyramid)
 from raft_tpu.kernels.corr_pallas import (corr_lookup_pallas, pad_pyramid,
                                           pallas_available)
 
-__all__ = ["corr_lookup_pallas", "pad_pyramid", "pallas_available"]
+__all__ = ["alt_corr_lookup_pallas", "corr_lookup_pallas", "pad_f2_pyramid",
+           "pad_pyramid", "pallas_available"]

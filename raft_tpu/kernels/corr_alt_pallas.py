@@ -1,0 +1,246 @@
+"""Pallas TPU kernel: on-the-fly windowed correlation (alt_cuda_corr).
+
+The reference's one native component computes the correlation lookup
+without materializing the (H·W)² volume: for each query pixel, dot fmap1's
+feature vector against the bilinearly-sampled fmap2 features in a (2r+1)²
+window around the current coords (alt_cuda_corr/correlation_kernel.cu:19-119,
+tiled shared-memory dot products). This is the memory regime for large
+resolutions — at the TRT envelope max 1024² the level-0 volume alone is
+~1 GB·B fp32 (SURVEY.md §5), while this path stores only the fmap2 pyramid.
+
+Kernel design (contrast with ``corr_pallas.py``, the materialized-pyramid
+lookup): there each query owns a private (Hl, Wl) slice, so block-streaming
+the volume is the only bandwidth-efficient option and per-query DMAs
+(~400 B) are latency-bound. Here fmap2 is SHARED across queries and a
+query's window spans all C channels — (2r+2)²·C ≈ 100 KB at C=256 — so
+per-query async copies are bandwidth-efficient. The kernel keeps a ring of
+window DMAs in flight from HBM, dots each arrival against the query's
+fmap1 row on the VPU (multiply + lane reduction over C — a matvec, which
+the MXU would waste a 128×128 tile on), and applies the separable 2-tap
+lerp vectorized over the query tile, exploiting that correlation is linear
+in fmap2: interpolate-then-dot ≡ sampling the true volume, exactly the
+identity the CUDA kernel's bilinear scatter form uses
+(correlation_kernel.cu:56-99).
+
+fmap2 levels are zero-padded by PAD = 2r+3 and coords clamped as in
+``corr_pallas`` — every window DMA is in-bounds and far-out-of-range
+queries read zeros (grid_sample padding_mode='zeros' semantics).
+
+Training: the reference's alt path is inference-only (its CUDA backward is
+never reachable from Python — ``core/corr.py:86`` calls ``.forward``
+directly; SURVEY.md §2 caveat a). Ours IS differentiable: a custom VJP
+delegates the backward to the XLA formulation (``models.corr
+.alt_corr_lookup``), which is algebraically identical, so training with
+``alternate_corr=True`` works without a hand-written scatter kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas import is gated so CPU-only installs still work
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+from raft_tpu.kernels.corr_pallas import _pad, pallas_available  # noqa: F401
+
+# interpret mode runs the kernel in pure XLA — used by CPU tests
+_INTERPRET = False
+
+_NBUF = 8    # window-DMA ring depth; each transfer is ~(2r+2)²·C·4 B
+_QTILE = 128  # queries per grid step
+
+
+def _alt_kernel(base_ref, wy_ref, wx_ref, f1_ref, f2_ref, out_ref,
+                ring, sems, win_ref, *, Q: int, K: int):
+    """One grid step: Q queries of one batch element.
+
+    base_ref: SMEM (1, Q, 2) i32 — in-bounds window starts (x0p, y0p)
+    wy/wx_ref: VMEM (1, Q, 1, 1) f32 — shared bilinear fracs
+    f1_ref:  VMEM (1, Q, C) f32 — query feature rows
+    f2_ref:  ANY (1, Hp, Wp, C) f32 — padded fmap2 level, resident in HBM
+    out_ref: VMEM (1, Q, K, K) f32 — [y, x] window (x-major swap outside)
+    ring:    VMEM scratch (_NBUF, P, P, C) DMA ring; sems: _NBUF DMA sems
+    win_ref: VMEM scratch (Q, P, P)
+    """
+    P = K + 1
+
+    def window_copy(q, slot):
+        x0 = base_ref[0, q, 0]
+        y0 = base_ref[0, q, 1]
+        return pltpu.make_async_copy(
+            f2_ref.at[0, pl.ds(y0, P), pl.ds(x0, P), :],
+            ring.at[slot],
+            sems.at[slot],
+        )
+
+    for q0 in range(min(_NBUF - 1, Q)):
+        window_copy(q0, q0 % _NBUF).start()
+
+    def body(q, _):
+        slot = jax.lax.rem(q, _NBUF)
+        nxt = q + _NBUF - 1
+
+        @pl.when(nxt < Q)
+        def _():
+            window_copy(nxt, jax.lax.rem(nxt, _NBUF)).start()
+
+        window_copy(q, slot).wait()
+        f2win = ring[slot]                       # (P, P, C)
+        f1q = f1_ref[0, q, :]                    # (C,) on lanes
+        win_ref[q] = jnp.sum(f2win * f1q, axis=-1)   # lane reduce -> (P, P)
+        return 0
+
+    jax.lax.fori_loop(0, Q, body, 0, unroll=False)
+
+    win = win_ref[:]                             # (Q, P, P) [y, x]
+    wy = wy_ref[0]                               # (Q, 1, 1)
+    wx = wx_ref[0]
+    wl = (1.0 - wy) * win[:, :K, :] + wy * win[:, 1:, :]
+    out_ref[0] = (1.0 - wx) * wl[:, :, :K] + wx * wl[:, :, 1:]
+
+
+def pad_f2_pyramid(f2_pyramid: Sequence[jax.Array], radius: int):
+    """Zero-pad each (B, Hl, Wl, C) level's spatial dims by the margin.
+
+    Do this once per forward pass, outside the scanned refinement loop.
+    """
+    PAD = _pad(radius)
+    return tuple(
+        jnp.pad(f2, ((0, 0), (PAD, PAD), (PAD, PAD), (0, 0)))
+        for f2 in f2_pyramid)
+
+
+def _prep_coords(Hl, Wl, x, y, radius):
+    PAD = _pad(radius)
+    x = jnp.clip(x, -(radius + 2.0), Wl + radius + 1.0)
+    y = jnp.clip(y, -(radius + 2.0), Hl + radius + 1.0)
+    xf = jnp.floor(x)
+    yf = jnp.floor(y)
+    B, N = x.shape
+    base = jnp.stack(
+        [xf.astype(jnp.int32) - radius + PAD,
+         yf.astype(jnp.int32) - radius + PAD], axis=-1)      # (B, N, 2)
+    wy = (y - yf).astype(jnp.float32).reshape(B, N, 1, 1)
+    wx = (x - xf).astype(jnp.float32).reshape(B, N, 1, 1)
+    return base, wy, wx
+
+
+def _level_alt_pallas(f1: jax.Array, f2_p: jax.Array, x: jax.Array,
+                      y: jax.Array, radius: int) -> jax.Array:
+    """f1 (B, N, C) + padded f2 (B, Hp, Wp, C) + coords -> (B, N, K²)."""
+    B, N, C = f1.shape
+    _, Hp, Wp, _ = f2_p.shape
+    K = 2 * radius + 1
+    PAD = _pad(radius)
+    base, wy, wx = _prep_coords(Hp - 2 * PAD, Wp - 2 * PAD, x, y, radius)
+
+    n_pad = (-N) % _QTILE
+    if n_pad:
+        pads = ((0, 0), (0, n_pad))
+        f1 = jnp.pad(f1, pads + ((0, 0),))
+        base = jnp.pad(base, pads + ((0, 0),))
+        wy = jnp.pad(wy, pads + ((0, 0), (0, 0)))
+        wx = jnp.pad(wx, pads + ((0, 0), (0, 0)))
+    Np = N + n_pad
+
+    kernel = functools.partial(_alt_kernel, Q=_QTILE, K=K)
+    scalar = pl.BlockSpec((1, _QTILE, 1, 1), lambda b, t: (b, t, 0, 0))
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, Np // _QTILE),
+        in_specs=[
+            pl.BlockSpec((1, _QTILE, 2), lambda b, t: (b, t, 0),
+                         memory_space=pltpu.SMEM),
+            scalar,
+            scalar,
+            pl.BlockSpec((1, _QTILE, C), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, Hp, Wp, C), lambda b, t: (b, 0, 0, 0),
+                         memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, _QTILE, K, K), lambda b, t: (b, t, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Np, K, K), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((_NBUF, K + 1, K + 1, C), jnp.float32),
+            pltpu.SemaphoreType.DMA((_NBUF,)),
+            pltpu.VMEM((_QTILE, K + 1, K + 1), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(base, wy, wx, f1.astype(jnp.float32), f2_p.astype(jnp.float32))
+    # [y, x] window -> x-major flat channels (models.corr layout contract)
+    return jnp.swapaxes(out[:, :N], -1, -2).reshape(B, N, K * K)
+
+
+def _alt_fwd_impl(fmap1, f2_pyramid_p, x, y, radius: int):
+    B, N, C = fmap1.shape
+    outs = [
+        _level_alt_pallas(fmap1, f2_p, x / (2 ** i), y / (2 ** i), radius)
+        for i, f2_p in enumerate(f2_pyramid_p)]
+    return jnp.concatenate(outs, axis=-1) / math.sqrt(C)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _alt_lookup(fmap1, f2_pyramid_p, x, y, radius: int):
+    return _alt_fwd_impl(fmap1, f2_pyramid_p, x, y, radius)
+
+
+def _alt_fwd(fmap1, f2_pyramid_p, x, y, radius: int):
+    return (_alt_fwd_impl(fmap1, f2_pyramid_p, x, y, radius),
+            (fmap1, f2_pyramid_p, x, y))
+
+
+def _alt_bwd(radius, res, g):
+    """Backward via the XLA formulation — algebraically identical math
+    (models.corr.alt_corr_lookup), so the adjoint is exact; no scatter
+    kernel needed (the reference's CUDA backward is dead code anyway)."""
+    from raft_tpu.models.corr import alt_corr_lookup
+
+    fmap1, f2_pyramid_p, x, y = res
+    B, N, C = fmap1.shape
+    PAD = _pad(radius)
+
+    def xla_fwd(f1, f2s, xq, yq):
+        # alt_corr_lookup takes (B,H,W,C) fmap1 and unpadded f2 pyramid +
+        # (B,H,W,2) coords; rebuild those shapes from the flat layout
+        f2_unpadded = [f2[:, PAD:-PAD, PAD:-PAD, :] for f2 in f2s]
+        coords = jnp.stack([xq, yq], axis=-1).reshape(B, 1, N, 2)
+        out = alt_corr_lookup(
+            f1.reshape(B, 1, N, C), f2_unpadded, coords, radius)
+        return out.reshape(B, N, -1)
+
+    _, vjp = jax.vjp(xla_fwd, fmap1, tuple(f2_pyramid_p), x, y)
+    return vjp(g)
+
+
+_alt_lookup.defvjp(_alt_fwd, _alt_bwd)
+
+
+def alt_corr_lookup_pallas(fmap1: jax.Array,
+                           f2_pyramid: Sequence[jax.Array],
+                           coords: jax.Array, radius: int,
+                           prepadded: bool = False) -> jax.Array:
+    """Drop-in for ``models.corr.alt_corr_lookup`` backed by Pallas.
+
+    fmap1 (B, H, W, C); f2_pyramid: (B, Hl, Wl, C) levels — or the output
+    of :func:`pad_f2_pyramid` when ``prepadded=True`` (pass that from
+    outside the refinement loop). coords (B, H, W, 2).
+    Returns (B, H, W, levels·K²) fp32.
+    """
+    B, H, W, C = fmap1.shape
+    N = H * W
+    f1 = fmap1.astype(jnp.float32).reshape(B, N, C)
+    x = coords[..., 0].reshape(B, N).astype(jnp.float32)
+    y = coords[..., 1].reshape(B, N).astype(jnp.float32)
+    f2p = (tuple(f2_pyramid) if prepadded
+           else pad_f2_pyramid(f2_pyramid, radius))
+    out = _alt_lookup(f1, f2p, x, y, radius)
+    return out.reshape(B, H, W, -1)

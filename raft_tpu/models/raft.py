@@ -91,11 +91,25 @@ class RAFT(nn.Module):
             for _ in range(cfg.corr_levels - 1):
                 f2 = avg_pool2x2(f2)
                 pyr.append(f2)
-            corr_state = (fmap1, tuple(pyr))
+            if cfg.corr_impl == "pallas":
+                from raft_tpu.kernels.corr_alt_pallas import (
+                    alt_corr_lookup_pallas, pad_f2_pyramid)
 
-            def lookup(state, coords):
-                f1, f2_pyr = state
-                return alt_corr_lookup(f1, f2_pyr, coords, cfg.corr_radius)
+                # pad once, outside the scanned loop (loop-invariant)
+                corr_state = (fmap1,
+                              pad_f2_pyramid(pyr, cfg.corr_radius))
+
+                def lookup(state, coords):
+                    f1, f2_pyr = state
+                    return alt_corr_lookup_pallas(
+                        f1, f2_pyr, coords, cfg.corr_radius, prepadded=True)
+            else:
+                corr_state = (fmap1, tuple(pyr))
+
+                def lookup(state, coords):
+                    f1, f2_pyr = state
+                    return alt_corr_lookup(f1, f2_pyr, coords,
+                                           cfg.corr_radius)
         else:
             corr_state = tuple(
                 build_corr_pyramid(fmap1, fmap2, cfg.corr_levels))
