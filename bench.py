@@ -62,14 +62,13 @@ def is_oom(exc: Exception) -> bool:
             or "out of memory" in s or "OOM" in s)
 
 
-def build(batch_size, remat, corr_impl=None):
+def build(batch_size, remat, overrides):
     from raft_tpu.config import RAFTConfig, stage_config
     from raft_tpu.training.train_step import (create_train_state,
                                               make_train_step)
 
-    overrides = {"corr_impl": corr_impl} if corr_impl else {}
     model_cfg = RAFTConfig(small=False, mixed_precision=True, remat=remat,
-                          **overrides)
+                           **overrides)
     train_cfg = stage_config("chairs", batch_size=batch_size)
     rng = jax.random.PRNGKey(0)
     state = create_train_state(model_cfg, train_cfg, rng, image_hw=IMAGE_HW)
@@ -89,11 +88,11 @@ def build(batch_size, remat, corr_impl=None):
     return state, step, batch, rng
 
 
-def run(batch_size, remat, warmup, steps, corr_impl=None):
+def run(batch_size, remat, warmup, steps, overrides):
     from raft_tpu.utils.timing import force_train as force
     warmup, steps = max(1, warmup), max(1, steps)  # force() needs metrics
-    log(f"building batch={batch_size} remat={remat} corr_impl={corr_impl}")
-    state, step, batch, rng = build(batch_size, remat, corr_impl)
+    log(f"building batch={batch_size} remat={remat} overrides={overrides}")
+    state, step, batch, rng = build(batch_size, remat, overrides)
     log("compiling + warmup")
     for _ in range(warmup):
         state, metrics = step(state, batch, rng)
@@ -128,6 +127,9 @@ def main():
                    help="no new attempt starts after this wall-clock budget")
     p.add_argument("--corr-impl", default=None,
                    help="override RAFTConfig.corr_impl (gather/onehot/pallas)")
+    p.add_argument("--corr-dtype", default=None,
+                   help="override RAFTConfig.corr_dtype (bfloat16 halves "
+                        "volume traffic; fp32 is reference parity)")
     args = p.parse_args()
 
     try:
@@ -143,9 +145,14 @@ def main():
         if time.monotonic() - START > args.deadline_s:
             log("deadline reached before attempt")
             break
+        overrides = {}
+        if args.corr_impl:
+            overrides["corr_impl"] = args.corr_impl
+        if args.corr_dtype:
+            overrides["corr_dtype"] = args.corr_dtype
         try:
             value = run(batch_size, args.remat, args.warmup, args.steps,
-                        args.corr_impl)
+                        overrides)
         except Exception as exc:
             last_err = exc
             if is_oom(exc):
@@ -156,6 +163,8 @@ def main():
         tag = "_remat" if args.remat else ""
         if args.corr_impl:
             tag += f"_{args.corr_impl}"
+        if args.corr_dtype:
+            tag += f"_corr{args.corr_dtype}"
         emit(f"raft_basic_train_chairs_368x496_bf16_b{batch_size}"
              f"_iters{ITERS}_1chip{tag}", value)
         return 0

@@ -41,6 +41,21 @@ class RAFTConfig:
     # `python -m raft_tpu.cli.corr_bench` (+ --grad); 'pallas' may take
     # over once its backward is validated on hardware.
     corr_impl: str = "onehot"
+    # storage dtype of the materialized correlation pyramid. The reference
+    # computes correlation in an fp32 island (core/raft.py:102-103) and so
+    # do we — the all-pairs GEMM always runs fp32 — but the *stored* volume
+    # is this dtype. The step is memory-bound and the volume is its largest
+    # tensor, read by all `iters` lookups fwd+bwd, so 'bfloat16' halves
+    # that traffic. Forward: window *selection* is exact in bf16 (one
+    # nonzero term per output) and the bilinear lerp runs fp32, so the
+    # only forward loss is the volume's storage rounding (~0.4% rel/entry,
+    # drift profile pinned in TestCorrDtypeBf16). Backward: the pyramid's
+    # cotangent is necessarily bf16 too and is summed across the scanned
+    # iterations at bf16 — an extra rounding the fmap gradients inherit
+    # (pinned in the same test class). Safe for inference; for training,
+    # treat as experimental until a loss-curve comparison exists.
+    # Default fp32 = bit-level reference parity.
+    corr_dtype: str = "float32"
     # rematerialize the refinement-iteration body in the backward pass:
     # trades ~30% recompute for dropping the per-iteration activation stack
     # (observed ~1.5 GB/buffer at chairs shapes), the jax.checkpoint lever

@@ -88,15 +88,19 @@ def _pad(radius: int) -> int:
     return 2 * radius + 3
 
 
-def _q_tile(Hp: int, Wp: int) -> int:
+def _q_tile(Hp: int, Wp: int, dtype=jnp.float32) -> int:
     """Queries per grid step: largest power of two with block ≤ _BLOCK_BYTES.
 
-    The lane (minor) dim is padded to 128 and the sublane dim to 8 by the
-    VMEM tiling, so budget with the padded footprint.
+    The lane (minor) dim is padded to 128 and the sublane dim to the
+    dtype's native tile (8 rows × 4 bytes: 8 for f32, 16 for bf16) by the
+    VMEM tiling, so budget with the padded footprint — a bf16 volume fits
+    twice the queries per block.
     """
+    itemsize = jnp.dtype(dtype).itemsize
+    sublane = 32 // itemsize
     lanes = -(-Wp // 128) * 128
-    subl = -(-Hp // 8) * 8
-    per_query = subl * lanes * 4
+    subl = -(-Hp // sublane) * sublane
+    per_query = subl * lanes * itemsize
     q = _BLOCK_BYTES // per_query
     tile = 8
     while tile * 2 <= q and tile < _QMAX:
@@ -138,13 +142,17 @@ def _lookup_kernel(y0_ref, x0_ref, wy_ref, wx_ref, vol_ref, out_ref,
     Hp, Wp = vol.shape[-2:]
     y0 = y0_ref[0]                                     # (Q, 1, 1)
     x0 = x0_ref[0]
+    zero = jnp.zeros((), vol.dtype)
 
-    # row select: for each integer offset p, a mask over the sublane axis
+    # row select: for each integer offset p, a mask over the sublane axis.
+    # Selection is EXACT in the volume's storage dtype (each output is a
+    # sum of zeros plus one entry), so a bf16 volume stays bf16 here —
+    # half the HBM traffic — and precision is applied at the fp32 lerp.
     ih = jax.lax.broadcasted_iota(jnp.int32, (Q, Hp, Wp), 1)
     for p in range(P):
         m = (ih == y0 + p)
         rows_ref[:, p:p + 1, :] = jnp.sum(
-            jnp.where(m, vol, 0.0), axis=1, keepdims=True)
+            jnp.where(m, vol, zero), axis=1, keepdims=True)
 
     # column select: same over the lane axis of the gathered rows
     rows = rows_ref[:]                                 # (Q, P, Wp)
@@ -152,9 +160,9 @@ def _lookup_kernel(y0_ref, x0_ref, wy_ref, wx_ref, vol_ref, out_ref,
     for p in range(P):
         m = (iw == x0 + p)
         win_ref[:, :, p:p + 1] = jnp.sum(
-            jnp.where(m, rows, 0.0), axis=2, keepdims=True)
+            jnp.where(m, rows, zero), axis=2, keepdims=True)
 
-    win = win_ref[:]                                   # (Q, P, P) [y, x]
+    win = win_ref[:].astype(jnp.float32)               # (Q, P, P) [y, x]
     wy = wy_ref[0]                                     # (Q, 1, 1)
     wx = wx_ref[0]
     wl = (1.0 - wy) * win[:, :K, :] + wy * win[:, 1:, :]
@@ -195,13 +203,14 @@ def _scatter_kernel(y0_ref, x0_ref, wy_ref, wx_ref, g_ref, dvol_ref,
         acc = acc + jnp.where(iw == x0 + p, dwin[:, :, p:p + 1], 0.0)
     drows_ref[...] = acc
 
-    # adjoint of row select: broadcast rows to their sublane offsets
+    # adjoint of row select: broadcast rows to their sublane offsets;
+    # cotangent dtype matches the (possibly bf16) volume's
     drows = drows_ref[:]                               # (Q, P, Wp)
     ih = jax.lax.broadcasted_iota(jnp.int32, (Q, Hp, Wp), 1)
     acc = jnp.zeros((Q, Hp, Wp), jnp.float32)
     for p in range(P):
         acc = acc + jnp.where(ih == y0 + p, drows[:, p:p + 1, :], 0.0)
-    dvol_ref[0] = acc
+    dvol_ref[0] = acc.astype(dvol_ref.dtype)
 
 
 def _prep_coords(shape_p, x, y, radius):
@@ -251,7 +260,7 @@ def _level_lookup_pallas(vol_p: jax.Array, x: jax.Array, y: jax.Array,
     N = x.shape[1]
     K = 2 * radius + 1
     y0, x0, wy, wx = _prep_coords(vol_p.shape, x, y, radius)
-    q_tile = _q_tile(Hp, Wp)
+    q_tile = _q_tile(Hp, Wp, vol_p.dtype)
     assert Np % q_tile == 0, (Np, q_tile)
     y0, x0, wy, wx = _pad_n([y0, x0, wy, wx], Np - N)
 
@@ -265,17 +274,17 @@ def _level_lookup_pallas(vol_p: jax.Array, x: jax.Array, y: jax.Array,
         out_specs=pl.BlockSpec((1, q_tile, K, K), lambda b, t: (b, t, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Np, K, K), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((q_tile, K + 1, Wp), jnp.float32),
-            pltpu.VMEM((q_tile, K + 1, K + 1), jnp.float32),
+            pltpu.VMEM((q_tile, K + 1, Wp), vol_p.dtype),
+            pltpu.VMEM((q_tile, K + 1, K + 1), vol_p.dtype),
         ],
         interpret=_INTERPRET,
-    )(y0, x0, wy, wx, vol_p.astype(jnp.float32))
+    )(y0, x0, wy, wx, vol_p)
     # [y, x] window -> x-major flat channels (models.corr layout contract)
     out = jnp.swapaxes(out[:, :N], -1, -2).reshape(B, N, K * K)
     return out
 
 
-def _level_scatter_pallas(g: jax.Array, shape_p, x: jax.Array,
+def _level_scatter_pallas(g: jax.Array, shape_p, vol_dtype, x: jax.Array,
                           y: jax.Array, radius: int) -> jax.Array:
     """Adjoint: (B, N, K²) x-major cotangent -> padded volume grad.
 
@@ -287,7 +296,7 @@ def _level_scatter_pallas(g: jax.Array, shape_p, x: jax.Array,
     N = x.shape[1]
     K = 2 * radius + 1
     y0, x0, wy, wx = _prep_coords(shape_p, x, y, radius)
-    q_tile = _q_tile(Hp, Wp)
+    q_tile = _q_tile(Hp, Wp, vol_dtype)
 
     g = jnp.swapaxes(g.reshape(B, N, K, K), -1, -2)    # x-major -> [y, x]
     y0, x0, wy, wx, g = _pad_n([y0, x0, wy, wx, g], Np - N)
@@ -301,7 +310,7 @@ def _level_scatter_pallas(g: jax.Array, shape_p, x: jax.Array,
         ],
         out_specs=pl.BlockSpec((1, q_tile, Hp, Wp),
                                lambda b, t: (b, t, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, Np, Hp, Wp), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((B, Np, Hp, Wp), vol_dtype),
         scratch_shapes=[
             pltpu.VMEM((q_tile, K + 1, K + 1), jnp.float32),
             pltpu.VMEM((q_tile, K, K + 1), jnp.float32),
@@ -324,8 +333,10 @@ def _lookup(pyramid_p, x, y, radius: int):
 
 
 def _lookup_fwd(pyramid_p, x, y, radius: int):
+    # residual leaves must be JAX types: shape as an int tuple, dtype via
+    # a zero-size token array
     return _lookup_fwd_impl(pyramid_p, x, y, radius), (
-        tuple(v.shape for v in pyramid_p), x, y)
+        tuple((v.shape, jnp.zeros((0,), v.dtype)) for v in pyramid_p), x, y)
 
 
 def _lookup_bwd(radius, res, g):
@@ -335,9 +346,9 @@ def _lookup_bwd(radius, res, g):
     # chain anyway, raft.py:123)
     d_pyramid = tuple(
         _level_scatter_pallas(
-            g[..., i * K2:(i + 1) * K2], shape,
+            g[..., i * K2:(i + 1) * K2], shape, token.dtype,
             x / (2 ** i), y / (2 ** i), radius)
-        for i, shape in enumerate(shapes))
+        for i, (shape, token) in enumerate(shapes))
     return d_pyramid, None, None
 
 
@@ -348,10 +359,12 @@ def corr_lookup_pallas(pyramid: Sequence[jax.Array], coords: jax.Array,
                        radius: int, prepadded: bool = False) -> jax.Array:
     """Drop-in for ``models.corr.corr_lookup`` backed by the Pallas kernel.
 
-    pyramid: list of (B, N, Hl, Wl) fp32 volumes — or the output of
-    :func:`pad_pyramid` when ``prepadded=True`` (pass that from outside the
-    refinement loop so the pad isn't re-done every iteration).
-    coords (B, H, W, 2). Returns (B, H, W, levels·K²) fp32.
+    pyramid: list of (B, N, Hl, Wl) volumes in fp32 OR bf16 (a bf16 volume
+    flows through unconverted — half the HBM traffic; selection is exact
+    in storage dtype and the lerp runs fp32, see ``RAFTConfig.corr_dtype``)
+    — or the output of :func:`pad_pyramid` when ``prepadded=True`` (pass
+    that from outside the refinement loop so the pad isn't re-done every
+    iteration). coords (B, H, W, 2). Returns (B, H, W, levels·K²) fp32.
     """
     B, H, W, _ = coords.shape
     N = H * W

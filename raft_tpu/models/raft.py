@@ -112,7 +112,8 @@ class RAFT(nn.Module):
                                            cfg.corr_radius)
         else:
             corr_state = tuple(
-                build_corr_pyramid(fmap1, fmap2, cfg.corr_levels))
+                v.astype(cfg.corr_dtype)
+                for v in build_corr_pyramid(fmap1, fmap2, cfg.corr_levels))
             if cfg.corr_impl == "pallas":
                 from raft_tpu.kernels import corr_lookup_pallas, pad_pyramid
 
