@@ -54,13 +54,23 @@ class RAFTConfig:
     # iterations at bf16 — an extra rounding the fmap gradients inherit
     # (pinned in the same test class). Safe for inference; for training,
     # treat as experimental until a loss-curve comparison exists.
-    # Default fp32 = bit-level reference parity.
+    # Default fp32 = bit-level reference parity. Applies only to the
+    # materialized pyramid — rejected with alternate_corr, which stores
+    # fmap pyramids, not a volume (see __post_init__).
     corr_dtype: str = "float32"
     # rematerialize the refinement-iteration body in the backward pass:
     # trades ~30% recompute for dropping the per-iteration activation stack
     # (observed ~1.5 GB/buffer at chairs shapes), the jax.checkpoint lever
     # HBM-bound training wants (SURVEY.md §7 "HBM bandwidth")
     remat: bool = False
+
+    def __post_init__(self):
+        if self.alternate_corr and self.corr_dtype != "float32":
+            raise ValueError(
+                "corr_dtype applies to the materialized correlation "
+                "pyramid only; alternate_corr never builds one, so "
+                f"corr_dtype={self.corr_dtype!r} would silently do "
+                "nothing — remove one of the two settings.")
 
     @property
     def hidden_dim(self) -> int:

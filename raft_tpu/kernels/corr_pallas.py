@@ -92,15 +92,18 @@ def _q_tile(Hp: int, Wp: int, dtype=jnp.float32) -> int:
     """Queries per grid step: largest power of two with block ≤ _BLOCK_BYTES.
 
     The lane (minor) dim is padded to 128 and the sublane dim to the
-    dtype's native tile (8 rows × 4 bytes: 8 for f32, 16 for bf16) by the
-    VMEM tiling, so budget with the padded footprint — a bf16 volume fits
-    twice the queries per block.
+    dtype's native tile (8 rows for f32, 16 for bf16) by the VMEM tiling.
+    The budget always charges 4 bytes/element: even with a bf16 volume the
+    kernels' dominant per-query intermediates stay 4-byte (the scatter's
+    fp32 accumulator and the iota masks span the same (Q, Hp, Wp) extent),
+    so a smaller itemsize must NOT double the tile — bf16's win is the
+    halved HBM DMA traffic, not a bigger tile.
     """
     itemsize = jnp.dtype(dtype).itemsize
     sublane = 32 // itemsize
     lanes = -(-Wp // 128) * 128
     subl = -(-Hp // sublane) * sublane
-    per_query = subl * lanes * itemsize
+    per_query = subl * lanes * 4
     q = _BLOCK_BYTES // per_query
     tile = 8
     while tile * 2 <= q and tile < _QMAX:

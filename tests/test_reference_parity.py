@@ -60,14 +60,24 @@ def sintel_pair():
     # the same lookup, so it must match the reference's materialized
     # CorrBlock output too (core/corr.py:63-91).
     (True, "alt", 12),
+    # the Pallas kernels (interpret mode on CPU — same program semantics),
+    # pinned DIRECTLY against the torch reference rather than transitively
+    # through the gather oracle
+    (True, "pallas", 12),
+    (True, "alt_pallas", 12),
 ])
 def test_full_model_flow_matches_reference(torch_raft, sintel_pair, small,
-                                           impl, iters):
+                                           impl, iters, monkeypatch):
     import argparse
 
     from raft_tpu.config import RAFTConfig
+    from raft_tpu.kernels import corr_alt_pallas, corr_pallas
     from raft_tpu.models import RAFT
     from raft_tpu.tools.convert import convert_state_dict
+
+    if impl in ("pallas", "alt_pallas"):
+        monkeypatch.setattr(corr_pallas, "_INTERPRET", True)
+        monkeypatch.setattr(corr_alt_pallas, "_INTERPRET", True)
 
     img1, img2 = sintel_pair
     h, w = img1.shape[:2]
@@ -85,6 +95,9 @@ def test_full_model_flow_matches_reference(torch_raft, sintel_pair, small,
 
     if impl == "alt":
         cfg = RAFTConfig(small=small, alternate_corr=True)
+    elif impl == "alt_pallas":
+        cfg = RAFTConfig(small=small, alternate_corr=True,
+                         corr_impl="pallas")
     else:
         cfg = RAFTConfig(small=small, corr_impl=impl)
     jmodel = RAFT(cfg)
