@@ -26,15 +26,18 @@ import jax
 import jax.numpy as jnp
 
 
-def bench_fn(fn, args, iters=20):
-    """Time ``iters`` applications of ``fn`` inside ONE executable (see
-    raft_tpu/utils/timing.py for the remote-backend fencing scheme) and
-    return (seconds/iter, one full output for parity comparison)."""
+def bench_fn(fn, coords, vols, iters=20):
+    """Time ``iters`` applications of ``fn(coords, vols)`` inside ONE
+    executable (see raft_tpu/utils/timing.py for the remote-backend
+    fencing scheme) and return (seconds/iter, one full output for parity
+    comparison). ``vols`` flows as a jit argument — closing over a
+    volume embeds it in the HLO as a literal constant, which the remote
+    compile endpoint rejects above ~hundreds of MB (HTTP 413)."""
     from raft_tpu.utils.timing import chain_timed
 
-    (coords,) = args
-    out = jax.tree_util.tree_map(np.asarray, fn(coords))  # parity, untimed
-    return chain_timed(fn, coords, iters), out
+    out = jax.tree_util.tree_map(np.asarray,
+                                 jax.jit(fn)(coords, vols))  # parity, untimed
+    return chain_timed(fn, coords, iters, vols), out
 
 
 def main(argv=None):
@@ -118,14 +121,14 @@ def main(argv=None):
             # Training cost: grads flow into the corr volume / fmaps (coords
             # are stop_gradient'ed each refinement iteration, raft.py loop),
             # so differentiate w.r.t. the volume inputs, not coords.
-            def run(c, _vols=vols, _fn=fn, _post=post):
+            def run(c, vols, _fn=fn, _post=post):
                 val, d = jax.value_and_grad(
-                    lambda v: jnp.sum(_fn(v, c) ** 2))(_vols)
+                    lambda v: jnp.sum(_fn(v, c) ** 2))(vols)
                 return val, (_post(d) if _post else d)
         else:
-            def run(c, _vols=vols, _fn=fn):
-                return _fn(_vols, c)
-        lookups[name] = jax.jit(run)
+            def run(c, vols, _fn=fn):
+                return _fn(vols, c)
+        lookups[name] = (run, vols)
 
     reference = None
     results = {}
@@ -134,7 +137,8 @@ def main(argv=None):
             print(f"{name:>8}: skipped (no TPU backend)")
             continue
         try:
-            dt, out = bench_fn(lookups[name], (coords,), iters=args.iters)
+            run, vols = lookups[name]
+            dt, out = bench_fn(run, coords, vols, iters=args.iters)
         except Exception as e:
             print(f"{name:>8}: FAILED {type(e).__name__}: {e}")
             continue

@@ -67,18 +67,22 @@ def _alt_kernel(base_ref, wy_ref, wx_ref, f1_ref, f2_ref, out_ref,
     base_ref: SMEM (1, Q, 2) i32 — in-bounds window starts (x0p, y0p)
     wy/wx_ref: VMEM (1, Q, 1, 1) f32 — shared bilinear fracs
     f1_ref:  VMEM (1, Q, C) f32 — query feature rows
-    f2_ref:  ANY (1, Hp, Wp, C) f32 — padded fmap2 level, resident in HBM
+    f2_ref:  ANY (B, Hp, Wp, C) f32 — padded fmap2 levels, resident in HBM.
+             Passed WHOLE (trivial index map): Mosaic only lowers
+             ANY-space operands unblocked, so the batch index comes from
+             ``program_id`` inside the DMA slice instead of a BlockSpec.
     out_ref: VMEM (1, Q, K, K) f32 — [y, x] window (x-major swap outside)
     ring:    VMEM scratch (_NBUF, P, P, C) DMA ring; sems: _NBUF DMA sems
     win_ref: VMEM scratch (Q, P, P)
     """
     P = K + 1
+    b = pl.program_id(0)
 
     def window_copy(q, slot):
         x0 = base_ref[0, q, 0]
         y0 = base_ref[0, q, 1]
         return pltpu.make_async_copy(
-            f2_ref.at[0, pl.ds(y0, P), pl.ds(x0, P), :],
+            f2_ref.at[b, pl.ds(y0, P), pl.ds(x0, P), :],
             ring.at[slot],
             sems.at[slot],
         )
@@ -164,8 +168,7 @@ def _level_alt_pallas(f1: jax.Array, f2_p: jax.Array, x: jax.Array,
             scalar,
             scalar,
             pl.BlockSpec((1, _QTILE, C), lambda b, t: (b, t, 0)),
-            pl.BlockSpec((1, Hp, Wp, C), lambda b, t: (b, 0, 0, 0),
-                         memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, _QTILE, K, K), lambda b, t: (b, t, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Np, K, K), jnp.float32),

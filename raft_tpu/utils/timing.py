@@ -12,6 +12,12 @@ Hazards this module encodes:
 - Timing a loop of separate dispatches measures dispatch; run the loop
   inside ONE executable, chained through a data dependency so XLA cannot
   hoist the loop-invariant body or dead-code-eliminate any output.
+- Arrays the timed function only READS (a correlation pyramid, weights)
+  must be passed as ``invariants`` — real jit arguments — never Python
+  closures: jit embeds closed-over arrays into the HLO as literal
+  constants, and on the remote backend a multi-hundred-MB program body
+  is rejected by the compile endpoint outright (HTTP 413; observed with
+  a 750 MB padded pyramid) and bloats every upload before that limit.
 """
 
 from __future__ import annotations
@@ -27,6 +33,11 @@ def chained_scan(fn: Callable, iters: int) -> Callable:
     """The timed executable: ``iters`` applications of ``fn`` chained
     through an output-derived input nudge, returning one scalar.
 
+    Returns a jitted ``(c, *invariants) -> scalar``; ``fn`` is called as
+    ``fn(c, *invariants)``. The invariants ride through the call as jit
+    parameters (see module docstring for why closures are forbidden) and
+    stay loop-invariant inside the scan — only ``c`` is nudged.
+
     The nudge consumes EVERY output leaf, so nothing inside ``fn`` — in
     particular a backward pass in a value_and_grad — is dead code, and the
     loop-invariant body cannot be hoisted out of the scan. Exposed
@@ -34,26 +45,29 @@ def chained_scan(fn: Callable, iters: int) -> Callable:
     HLO for exactly this property.
     """
 
-    def step(c, _):
-        out = fn(c)
-        probe = sum(jnp.sum(leaf)
-                    for leaf in jax.tree_util.tree_leaves(out))
-        return c + (probe * 1e-12).astype(c.dtype), ()
+    def run(c, *invariants):
+        def step(c, _):
+            out = fn(c, *invariants)
+            probe = sum(jnp.sum(leaf)
+                        for leaf in jax.tree_util.tree_leaves(out))
+            return c + (probe * 1e-12).astype(c.dtype), ()
 
-    return jax.jit(
-        lambda c: jnp.ravel(jax.lax.scan(step, c, None, length=iters)[0])[0])
+        return jnp.ravel(jax.lax.scan(step, c, None, length=iters)[0])[0]
+
+    return jax.jit(run)
 
 
-def chain_timed(fn: Callable, x0: jax.Array, iters: int) -> float:
+def chain_timed(fn: Callable, x0: jax.Array, iters: int,
+                *invariants) -> float:
     """Seconds per application of ``fn``, measured inside one executable.
 
-    ``fn(x)`` may return any pytree. Returns seconds/iteration; one
-    compile+warm call runs first.
+    ``fn(x, *invariants)`` may return any pytree. Returns
+    seconds/iteration; one compile+warm call runs first.
     """
     scanned = chained_scan(fn, iters)
-    float(scanned(x0))                  # compile + warm (not timed)
+    float(scanned(x0, *invariants))     # compile + warm (not timed)
     t0 = time.perf_counter()
-    float(scanned(x0))                  # scalar fetch fences all iterations
+    float(scanned(x0, *invariants))     # scalar fetch fences all iterations
     return (time.perf_counter() - t0) / iters
 
 
