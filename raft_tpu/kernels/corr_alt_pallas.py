@@ -22,6 +22,17 @@ in fmap2: interpolate-then-dot ≡ sampling the true volume, exactly the
 identity the CUDA kernel's bilinear scatter form uses
 (correlation_kernel.cu:56-99).
 
+DMA alignment (learned on-chip): in the (B, Hp, Wp, C) layout the tiled
+dims are (Wp, C) — sublane and lane — and Mosaic rejects DMA slices whose
+W span isn't a multiple of the 8-row sublane tile ("Slice shape along
+dimension 2 must be aligned to tiling (8), but is 10"). So the copy takes
+an 8-ALIGNED W span: the window's W start rounds down to a multiple of 8
+and the span widens to ``_wspan(P)`` (24 for P=10); H spans are untiled
+and stay exact. The true P columns are selected AFTER the channel
+reduction — once C is reduced away, W is the lane axis of the (P, WSPAN)
+correlation patch, where a per-offset iota mask (corr_pallas's trick)
+extracts column j = sub-offset + j without any unaligned slicing.
+
 fmap2 levels are zero-padded by PAD = 2r+3 and coords clamped as in
 ``corr_pallas`` — every window DMA is in-bounds and far-out-of-range
 queries read zeros (grid_sample padding_mode='zeros' semantics).
@@ -56,15 +67,21 @@ from raft_tpu.kernels.corr_pallas import _pad, pallas_available  # noqa: F401
 # interpret mode runs the kernel in pure XLA — used by CPU tests
 _INTERPRET = False
 
-_NBUF = 8    # window-DMA ring depth; each transfer is ~(2r+2)²·C·4 B
+_NBUF = 8    # window-DMA ring depth; each transfer is ~(2r+2)·WSPAN·C·4 B
 _QTILE = 128  # queries per grid step
+
+
+def _wspan(P: int) -> int:
+    """8-aligned W extent covering a P-wide window at any sub-offset < 8."""
+    return -(-(P + 7) // 8) * 8
 
 
 def _alt_kernel(base_ref, wy_ref, wx_ref, f1_ref, f2_ref, out_ref,
                 ring, sems, win_ref, *, Q: int, K: int):
     """One grid step: Q queries of one batch element.
 
-    base_ref: SMEM (1, Q, 2) i32 — in-bounds window starts (x0p, y0p)
+    base_ref: SMEM (1, Q, 3) i32 — 8-aligned W start x0a, H start y0, and
+             the sub-offset off = x0 - x0a ∈ [0, 8)
     wy/wx_ref: VMEM (1, Q, 1, 1) f32 — shared bilinear fracs
     f1_ref:  VMEM (1, Q, C) f32 — query feature rows
     f2_ref:  ANY (B, Hp, Wp, C) f32 — padded fmap2 levels, resident in HBM.
@@ -72,17 +89,18 @@ def _alt_kernel(base_ref, wy_ref, wx_ref, f1_ref, f2_ref, out_ref,
              ANY-space operands unblocked, so the batch index comes from
              ``program_id`` inside the DMA slice instead of a BlockSpec.
     out_ref: VMEM (1, Q, K, K) f32 — [y, x] window (x-major swap outside)
-    ring:    VMEM scratch (_NBUF, P, P, C) DMA ring; sems: _NBUF DMA sems
+    ring:    VMEM scratch (_NBUF, P, WSPAN, C) DMA ring; sems: DMA sems
     win_ref: VMEM scratch (Q, P, P)
     """
     P = K + 1
+    WSPAN = _wspan(P)
     b = pl.program_id(0)
 
     def window_copy(q, slot):
-        x0 = base_ref[0, q, 0]
+        x0a = base_ref[0, q, 0]
         y0 = base_ref[0, q, 1]
         return pltpu.make_async_copy(
-            f2_ref.at[b, pl.ds(y0, P), pl.ds(x0, P), :],
+            f2_ref.at[b, pl.ds(y0, P), pl.ds(x0a, WSPAN), :],
             ring.at[slot],
             sems.at[slot],
         )
@@ -99,9 +117,18 @@ def _alt_kernel(base_ref, wy_ref, wx_ref, f1_ref, f2_ref, out_ref,
             window_copy(nxt, jax.lax.rem(nxt, _NBUF)).start()
 
         window_copy(q, slot).wait()
-        f2win = ring[slot]                       # (P, P, C)
+        f2win = ring[slot]                       # (P, WSPAN, C)
         f1q = f1_ref[0, q, :]                    # (C,) on lanes
-        win_ref[q] = jnp.sum(f2win * f1q, axis=-1)   # lane reduce -> (P, P)
+        patch = jnp.sum(f2win * f1q, axis=-1)    # lane reduce -> (P, WSPAN)
+        # select the true P window columns at the sub-offset: after the C
+        # reduction W is the lane axis, so an iota mask per column offset
+        # replaces the unaligned slice the DMA couldn't do
+        off = base_ref[0, q, 2]
+        iw = jax.lax.broadcasted_iota(jnp.int32, (P, WSPAN), 1)
+        for j in range(P):
+            col = jnp.sum(jnp.where(iw == off + j, patch, 0.0),
+                          axis=1, keepdims=True)      # (P, 1)
+            win_ref[q, :, j:j + 1] = col
         return 0
 
     jax.lax.fori_loop(0, Q, body, 0, unroll=False)
@@ -116,11 +143,15 @@ def _alt_kernel(base_ref, wy_ref, wx_ref, f1_ref, f2_ref, out_ref,
 def pad_f2_pyramid(f2_pyramid: Sequence[jax.Array], radius: int):
     """Zero-pad each (B, Hl, Wl, C) level's spatial dims by the margin.
 
+    W gets ``_wspan`` extra zeros on the right so the kernel's 8-aligned,
+    widened window DMA stays in bounds for the rightmost queries.
     Do this once per forward pass, outside the scanned refinement loop.
     """
     PAD = _pad(radius)
+    P = 2 * radius + 2
+    extra = _wspan(P) - P  # DMA-end bound: x0a + WSPAN <= Wl + 2*PAD + extra
     return tuple(
-        jnp.pad(f2, ((0, 0), (PAD, PAD), (PAD, PAD), (0, 0)))
+        jnp.pad(f2, ((0, 0), (PAD, PAD), (PAD, PAD + extra), (0, 0)))
         for f2 in f2_pyramid)
 
 
@@ -131,9 +162,11 @@ def _prep_coords(Hl, Wl, x, y, radius):
     xf = jnp.floor(x)
     yf = jnp.floor(y)
     B, N = x.shape
+    x0 = xf.astype(jnp.int32) - radius + PAD
+    x0a = (x0 // 8) * 8                          # 8-aligned DMA start
     base = jnp.stack(
-        [xf.astype(jnp.int32) - radius + PAD,
-         yf.astype(jnp.int32) - radius + PAD], axis=-1)      # (B, N, 2)
+        [x0a, yf.astype(jnp.int32) - radius + PAD, x0 - x0a],
+        axis=-1)                                 # (B, N, 3)
     wy = (y - yf).astype(jnp.float32).reshape(B, N, 1, 1)
     wx = (x - xf).astype(jnp.float32).reshape(B, N, 1, 1)
     return base, wy, wx
@@ -163,7 +196,7 @@ def _level_alt_pallas(f1: jax.Array, f2_p: jax.Array, x: jax.Array,
         kernel,
         grid=(B, Np // _QTILE),
         in_specs=[
-            pl.BlockSpec((1, _QTILE, 2), lambda b, t: (b, t, 0),
+            pl.BlockSpec((1, _QTILE, 3), lambda b, t: (b, t, 0),
                          memory_space=pltpu.SMEM),
             scalar,
             scalar,
@@ -173,7 +206,7 @@ def _level_alt_pallas(f1: jax.Array, f2_p: jax.Array, x: jax.Array,
         out_specs=pl.BlockSpec((1, _QTILE, K, K), lambda b, t: (b, t, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Np, K, K), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((_NBUF, K + 1, K + 1, C), jnp.float32),
+            pltpu.VMEM((_NBUF, K + 1, _wspan(K + 1), C), jnp.float32),
             pltpu.SemaphoreType.DMA((_NBUF,)),
             pltpu.VMEM((_QTILE, K + 1, K + 1), jnp.float32),
         ],
@@ -210,11 +243,13 @@ def _alt_bwd(radius, res, g):
     fmap1, f2_pyramid_p, x, y = res
     B, N, C = fmap1.shape
     PAD = _pad(radius)
+    P = 2 * radius + 2
+    extra = _wspan(P) - P  # pad_f2_pyramid's extra right-W margin
 
     def xla_fwd(f1, f2s, xq, yq):
         # alt_corr_lookup takes (B,H,W,C) fmap1 and unpadded f2 pyramid +
         # (B,H,W,2) coords; rebuild those shapes from the flat layout
-        f2_unpadded = [f2[:, PAD:-PAD, PAD:-PAD, :] for f2 in f2s]
+        f2_unpadded = [f2[:, PAD:-PAD, PAD:-(PAD + extra), :] for f2 in f2s]
         coords = jnp.stack([xq, yq], axis=-1).reshape(B, 1, N, 2)
         out = alt_corr_lookup(
             f1.reshape(B, 1, N, C), f2_unpadded, coords, radius)

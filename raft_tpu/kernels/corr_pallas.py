@@ -67,10 +67,14 @@ except Exception:  # pragma: no cover
 # interpret mode runs the kernel in pure XLA — used by CPU tests
 _INTERPRET = False
 
-# VMEM budget for one (query-tile, Hp, Wp) volume block. The pipeline keeps
-# two blocks in flight (double buffering), and the ~16 MB/core VMEM also
-# holds the out/scratch tiles, so cap a single block at 2 MB.
-_BLOCK_BYTES = 2 * 1024 * 1024
+# Scoped-VMEM budget for ONE grid step of either kernel, covering
+# everything the Mosaic stack allocator charges: pipelined in/out blocks
+# (×2 for double buffering), scratch, and kernel-body intermediates.
+# The hard limit is 16 MB (observed on-chip: a 17.09 MB scatter step at
+# the 27×29 pyramid level was rejected with "scoped allocation ...
+# exceeded scoped vmem limit"); 10 MB leaves headroom for Mosaic's own
+# spills and for estimate error.
+_SCOPED_BUDGET = 10 * 1024 * 1024
 
 _QMAX = 256  # every _q_tile() value is a power of two ≤ this
 
@@ -88,23 +92,39 @@ def _pad(radius: int) -> int:
     return 2 * radius + 3
 
 
-def _q_tile(Hp: int, Wp: int, dtype=jnp.float32) -> int:
-    """Queries per grid step: largest power of two with block ≤ _BLOCK_BYTES.
+def _q_tile(Hp: int, Wp: int, radius: int) -> int:
+    """Queries per grid step: largest power of two whose full scoped-VMEM
+    footprint fits ``_SCOPED_BUDGET``.
 
-    The lane (minor) dim is padded to 128 and the sublane dim to the
-    dtype's native tile (8 rows for f32, 16 for bf16) by the VMEM tiling.
-    The budget always charges 4 bytes/element: even with a bf16 volume the
-    kernels' dominant per-query intermediates stay 4-byte (the scatter's
-    fp32 accumulator and the iota masks span the same (Q, Hp, Wp) extent),
-    so a smaller itemsize must NOT double the tile — bf16's win is the
-    halved HBM DMA traffic, not a bigger tile.
+    Models what the Mosaic stack allocator actually charges per grid step
+    — every term scales with Q, so the budget divides into a per-query
+    cost. VMEM tiling pads each buffer's sublane (second-minor) dim to 8
+    and lane (minor) dim to 128 for 4-byte types; in particular a
+    (1, Q, 1, 1) scalar block pads to (1, Q, 8, 128) = 4 KB/query, which
+    is why the small pyramid levels — not the large ones — used to
+    overflow: their spatial term shrank while four padded scalar blocks,
+    the window scratch, and the (K, K) in/out blocks didn't.
+
+    Charged at 4 bytes/element regardless of volume dtype: even with a
+    bf16 volume the dominant per-query intermediates stay 4-byte (iota
+    masks, the scatter's fp32 accumulator), so a smaller itemsize must
+    NOT grow the tile — bf16's win is the halved HBM DMA traffic.
     """
-    itemsize = jnp.dtype(dtype).itemsize
-    sublane = 32 // itemsize
-    lanes = -(-Wp // 128) * 128
-    subl = -(-Hp // sublane) * sublane
-    per_query = subl * lanes * 4
-    q = _BLOCK_BYTES // per_query
+    K = 2 * radius + 1
+    P = K + 1
+
+    def pad2(sub, lane):
+        return (-(-sub // 8) * 8) * (-(-lane // 128) * 128)
+
+    spatial = pad2(Hp, Wp)            # one (Hp, Wp) slice, padded
+    per_query_elems = (
+        2 * spatial                   # vol / dvol block, double-buffered
+        + 3 * spatial                 # iota + masked-select/acc stack temps
+        + 2 * pad2(P, Wp)             # rows / drows scratch (+ its temp)
+        + 3 * pad2(P, P)              # win / dwin / dwl scratch
+        + 2 * 2 * pad2(K, K)          # out / g blocks, double-buffered
+        + 4 * pad2(1, 1))             # y0/x0/wy/wx blocks (pad to 8x128)
+    q = _SCOPED_BUDGET // (per_query_elems * 4)
     tile = 8
     while tile * 2 <= q and tile < _QMAX:
         tile *= 2
@@ -263,7 +283,7 @@ def _level_lookup_pallas(vol_p: jax.Array, x: jax.Array, y: jax.Array,
     N = x.shape[1]
     K = 2 * radius + 1
     y0, x0, wy, wx = _prep_coords(vol_p.shape, x, y, radius)
-    q_tile = _q_tile(Hp, Wp, vol_p.dtype)
+    q_tile = _q_tile(Hp, Wp, radius)
     assert Np % q_tile == 0, (Np, q_tile)
     y0, x0, wy, wx = _pad_n([y0, x0, wy, wx], Np - N)
 
@@ -299,7 +319,7 @@ def _level_scatter_pallas(g: jax.Array, shape_p, vol_dtype, x: jax.Array,
     N = x.shape[1]
     K = 2 * radius + 1
     y0, x0, wy, wx = _prep_coords(shape_p, x, y, radius)
-    q_tile = _q_tile(Hp, Wp, vol_dtype)
+    q_tile = _q_tile(Hp, Wp, radius)
 
     g = jnp.swapaxes(g.reshape(B, N, K, K), -1, -2)    # x-major -> [y, x]
     y0, x0, wy, wx, g = _pad_n([y0, x0, wy, wx, g], Np - N)
