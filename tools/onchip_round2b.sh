@@ -1,0 +1,35 @@
+#!/bin/bash
+# Follow-up on-chip runbook (round 2, session B) — run after
+# tools/onchip_runbook.sh. Validates the two kernel fixes that came out of
+# the first session's failures (scoped-VMEM tiling, 8-aligned alt DMA) and
+# finishes the measurement program with the onehot default.
+set -u
+cd /root/repo
+OUT=${1:-/tmp/onchip_round2b.out}
+log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
+
+log "1 corr_bench chairs fwd+grad, pallas vs onehot (post scoped-VMEM fix)"
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls onehot pallas >> "$OUT" 2>&1
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls onehot pallas --grad >> "$OUT" 2>&1
+
+log "2 corr_bench alt_pallas (post alignment fix), chairs + 128x128"
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls alt alt_pallas >> "$OUT" 2>&1
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 1 --hw 128 128 \
+    --iters 10 --impls alt alt_pallas >> "$OUT" 2>&1
+
+log "3 bench.py batch ladder with the onehot default (b8 first)"
+timeout 2400 python bench.py --steps 10 --batches 8 >> "$OUT" 2>&1
+timeout 2400 python bench.py --steps 10 --batches 8 --remat >> "$OUT" 2>&1
+
+log "4 bench.py corr_dtype=bfloat16 (halved volume traffic)"
+timeout 2400 python bench.py --steps 10 --batches 6 \
+    --corr-dtype bfloat16 >> "$OUT" 2>&1
+
+log "5 profile_step trace with the onehot default"
+timeout 2400 python -m raft_tpu.cli.profile_step --batch 6 --steps 10 \
+    --trace-dir /tmp/raft_trace_onehot >> "$OUT" 2>&1
+
+log "done"
