@@ -57,7 +57,15 @@ def log(msg):
 
 
 def is_oom(exc: Exception) -> bool:
+    """HBM exhaustion (worth retrying smaller) vs everything else (fatal).
+
+    Scoped-VMEM compile errors also say "Ran out of memory" but are
+    batch-INdependent kernel-tiling failures — retrying smaller batches
+    burned 3 multi-minute remote compiles on one in session B.
+    """
     s = f"{type(exc).__name__}: {exc}"
+    if "scoped vmem" in s or "memory space vmem" in s:
+        return False
     return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
             or "out of memory" in s or "OOM" in s)
 

@@ -133,6 +133,10 @@ def main(argv=None):
     reference = None
     results = {}
     for name in args.impls:
+        if name not in impls:
+            print(f"{name:>8}: unknown impl (choose from "
+                  f"{', '.join(impls)})")
+            continue
         if name in ("pallas", "alt_pallas") and not pallas_available():
             print(f"{name:>8}: skipped (no TPU backend)")
             continue

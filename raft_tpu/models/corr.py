@@ -175,15 +175,24 @@ def corr_lookup_onehot(pyramid: Sequence[jax.Array], coords: jax.Array,
         taps = jnp.arange(P, dtype=jnp.int32)
         rows = y0[..., None] + taps                          # (B, N, P)
         cols = x0[..., None] + taps
-        sel_y = (rows[..., None] == jnp.arange(Hl)).astype(jnp.float32)
-        sel_x = (cols[..., None] == jnp.arange(Wl)).astype(jnp.float32)
-        # HIGHEST: the lookup reads the fp32 corr island (raft.py:102-103);
-        # default TPU precision would round it through bf16 MXU passes
+        # Selection is EXACT at the volume's own dtype: each output element
+        # is one volume entry times 1.0 (plus zeros), and 0/1 are exact in
+        # bf16. So for the fp32 corr island (raft.py:102-103) force fp32
+        # MXU passes (HIGHEST — default precision would round the entries
+        # to bf16), while a bf16-stored volume (corr_dtype='bfloat16')
+        # rides the MXU at native bf16 rate with bf16 one-hots — 4× the
+        # fp32 rate and half the operand traffic, bit-identical to
+        # selecting from the same bf16 volume in fp32.
+        fp32_vol = vol.dtype == jnp.float32
+        sel_dtype = jnp.float32 if fp32_vol else vol.dtype
+        prec = HIGHEST if fp32_vol else None
+        sel_y = (rows[..., None] == jnp.arange(Hl)).astype(sel_dtype)
+        sel_x = (cols[..., None] == jnp.arange(Wl)).astype(sel_dtype)
         tmp = jnp.einsum("bnph,bnhw->bnpw", sel_y, vol,
-                         precision=HIGHEST)                  # row select
+                         precision=prec)                     # row select
         win = jnp.einsum("bnpw,bnqw->bnpq", tmp, sel_x,
-                         precision=HIGHEST)                  # col select
-        out.append(_separable_lerp(win, wx, wy, radius))
+                         precision=prec)                     # col select
+        out.append(_separable_lerp(win.astype(jnp.float32), wx, wy, radius))
     return jnp.concatenate(out, axis=-1).reshape(B, H, W, -1)
 
 

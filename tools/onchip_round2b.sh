@@ -21,8 +21,8 @@ timeout 2400 python -m raft_tpu.cli.corr_bench --batch 1 --hw 128 128 \
     --iters 10 --impls alt alt_pallas >> "$OUT" 2>&1
 
 log "3 bench.py batch ladder with the onehot default (b8 first)"
-timeout 2400 python bench.py --steps 10 --batches 8 >> "$OUT" 2>&1
-timeout 2400 python bench.py --steps 10 --batches 8 --remat >> "$OUT" 2>&1
+timeout 2400 python bench.py --steps 10 --batches 8 6 >> "$OUT" 2>&1
+timeout 2400 python bench.py --steps 10 --batches 8 6 --remat >> "$OUT" 2>&1
 
 log "4 bench.py corr_dtype=bfloat16 (halved volume traffic)"
 timeout 2400 python bench.py --steps 10 --batches 6 \
