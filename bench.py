@@ -40,9 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 
 # persistent compile cache: repeat bench runs skip the multi-minute compile
-jax.config.update("jax_compilation_cache_dir", "/tmp/raft_tpu_jax_cache_tpu")
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+from raft_tpu.utils.platform import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache("tpu")
 
 BASELINE_PAIRS_PER_SEC = 20.0  # est. 2xV100 reference recipe (see docstring)
 IMAGE_HW = (368, 496)          # train_standard.sh chairs crop
@@ -63,11 +63,11 @@ def is_oom(exc: Exception) -> bool:
     batch-INdependent kernel-tiling failures — retrying smaller batches
     burned 3 multi-minute remote compiles on one in session B.
     """
-    s = f"{type(exc).__name__}: {exc}"
+    s = f"{type(exc).__name__}: {exc}".lower()
     if "scoped vmem" in s or "memory space vmem" in s:
         return False
-    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
-            or "out of memory" in s or "OOM" in s)
+    return ("resource_exhausted" in s or "out of memory" in s
+            or "oom" in s)
 
 
 def build(batch_size, remat, overrides):

@@ -1,0 +1,29 @@
+"""Shared CLI argument groups.
+
+One definition of the correlation-backend knobs for every entry point
+(demo, evaluate, profile_step) so the flags and their RAFTConfig plumbing
+cannot drift apart. Validation of the VALUES lives in
+``RAFTConfig.__post_init__`` — the single choke point every caller
+(including bench.py's dash-style flags) already goes through.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def add_corr_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--corr_impl", default=None,
+                   choices=["gather", "onehot", "pallas"],
+                   help="lookup backend override (default: RAFTConfig's)")
+    p.add_argument("--corr_dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="correlation-pyramid storage dtype; 'bfloat16' "
+                        "halves volume traffic (see RAFTConfig.corr_dtype)")
+
+
+def corr_overrides(args: argparse.Namespace) -> dict:
+    """RAFTConfig kwargs for the flags :func:`add_corr_args` added."""
+    return {k: v for k, v in (("corr_impl", args.corr_impl),
+                              ("corr_dtype", args.corr_dtype))
+            if v is not None}

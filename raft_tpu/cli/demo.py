@@ -18,6 +18,7 @@ from PIL import Image
 
 import jax.numpy as jnp
 
+from raft_tpu.cli._args import add_corr_args, corr_overrides
 from raft_tpu.config import ITERS_DEMO, RAFTConfig
 from raft_tpu.ops.padding import InputPadder
 from raft_tpu.utils.flow_viz import flow_to_image
@@ -37,6 +38,7 @@ def main(argv=None):
     p.add_argument("--small", action="store_true")
     p.add_argument("--mixed_precision", action="store_true")
     p.add_argument("--alternate_corr", action="store_true")
+    add_corr_args(p)
     p.add_argument("--iters", type=int, default=ITERS_DEMO)
     args = p.parse_args(argv)
 
@@ -44,7 +46,8 @@ def main(argv=None):
     from raft_tpu.training.trainer import load_weights
 
     cfg = RAFTConfig(small=args.small, mixed_precision=args.mixed_precision,
-                     alternate_corr=args.alternate_corr)
+                     alternate_corr=args.alternate_corr,
+                     **corr_overrides(args))
     variables = load_weights(args.model, cfg)
     fwd, _ = make_forward(cfg, args.iters)
 

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import argparse
 
+from raft_tpu.cli._args import add_corr_args, corr_overrides
 from raft_tpu.config import RAFTConfig
 
 
@@ -15,6 +16,7 @@ def main(argv=None):
     p.add_argument("--small", action="store_true")
     p.add_argument("--mixed_precision", action="store_true")
     p.add_argument("--alternate_corr", action="store_true")
+    add_corr_args(p)
     p.add_argument("--data_root", default="datasets")
     p.add_argument("--submission", action="store_true",
                    help="write a leaderboard submission instead of validating")
@@ -27,7 +29,8 @@ def main(argv=None):
     from raft_tpu.training.trainer import load_weights
 
     cfg = RAFTConfig(small=args.small, mixed_precision=args.mixed_precision,
-                     alternate_corr=args.alternate_corr)
+                     alternate_corr=args.alternate_corr,
+                     **corr_overrides(args))
     variables = load_weights(args.model, cfg)
 
     if args.submission:

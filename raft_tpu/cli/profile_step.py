@@ -34,8 +34,9 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)  # min 1: force() reads
     # the warmup loop's metrics; clamped below
-    p.add_argument("--corr-impl", default=None,
-                   help="override corr_impl (gather/onehot/pallas)")
+    from raft_tpu.cli._args import add_corr_args
+
+    add_corr_args(p)
     p.add_argument("--remat", action="store_true")
     p.add_argument("--fp32", action="store_true",
                    help="disable bf16 mixed precision")
@@ -45,18 +46,17 @@ def main(argv=None):
     args.warmup = max(1, args.warmup)
     args.steps = max(1, args.steps)
 
-    jax.config.update("jax_compilation_cache_dir",
-                      "/tmp/raft_tpu_jax_cache_tpu")
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    from raft_tpu.utils.platform import enable_persistent_cache
+
+    enable_persistent_cache("tpu")
 
     from raft_tpu.config import RAFTConfig, stage_config
     from raft_tpu.training.train_step import (create_train_state,
                                               make_train_step)
 
-    overrides = {}
-    if args.corr_impl:
-        overrides["corr_impl"] = args.corr_impl
+    from raft_tpu.cli._args import corr_overrides
+
+    overrides = corr_overrides(args)
     model_cfg = RAFTConfig(small=False, mixed_precision=not args.fp32,
                            remat=args.remat, **overrides)
     train_cfg = stage_config("chairs", batch_size=args.batch,

@@ -49,11 +49,18 @@ class RAFTConfig:
     # that traffic. Forward: window *selection* is exact in bf16 (one
     # nonzero term per output) and the bilinear lerp runs fp32, so the
     # only forward loss is the volume's storage rounding (~0.4% rel/entry,
-    # drift profile pinned in TestCorrDtypeBf16). Backward: the pyramid's
+    # drift profile pinned in TestCorrDtypeBf16; bf16 volumes also run the
+    # selection GEMMs at native bf16 MXU rate). Backward: the pyramid's
     # cotangent is necessarily bf16 too and is summed across the scanned
     # iterations at bf16 — an extra rounding the fmap gradients inherit
-    # (pinned in the same test class). Safe for inference; for training,
-    # treat as experimental until a loss-curve comparison exists.
+    # (pinned in the same test class). Caveat measured at model level
+    # (test_corr_dtype_bf16_model_drift): the refinement recurrence
+    # amplifies ANY volume-scale perturbation when the weights don't
+    # contract it — at random init, bf16 rounding and an equivalent fp32
+    # noise control both compound identically — so confirm end-to-end
+    # parity at trained weights (EPE on a converted checkpoint) before
+    # relying on it for leaderboard numbers; for training, treat as
+    # experimental until a loss-curve comparison exists.
     # Default fp32 = bit-level reference parity. Applies only to the
     # materialized pyramid — rejected with alternate_corr, which stores
     # fmap pyramids, not a volume (see __post_init__).
@@ -65,6 +72,17 @@ class RAFTConfig:
     remat: bool = False
 
     def __post_init__(self):
+        if self.corr_impl not in ("gather", "onehot", "pallas"):
+            raise ValueError(
+                f"corr_impl={self.corr_impl!r}: choose gather, onehot, or "
+                "pallas (the memory-efficient alternate path is selected "
+                "by alternate_corr=True, with corr_impl picking its "
+                "XLA/pallas backend)")
+        if self.corr_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"corr_dtype={self.corr_dtype!r}: choose 'float32' "
+                "(bit-level reference parity) or 'bfloat16' (halved "
+                "volume traffic; see the corr_dtype comment)")
         if self.alternate_corr and self.corr_dtype != "float32":
             raise ValueError(
                 "corr_dtype applies to the materialized correlation "

@@ -11,6 +11,33 @@ tunnel. Same guard as tests/conftest.py and __graft_entry__.py.
 from __future__ import annotations
 
 import os
+import tempfile
+
+
+def jax_cache_dir(tag: str) -> str:
+    """Per-user persistent-compile-cache dir for ``tag`` (e.g. 'tpu').
+
+    Per-user because the cache holds trusted serialized executables at a
+    guessable path — a world-shared /tmp name would let another local
+    user pre-plant entries (and breaks with permission errors anyway).
+    Override with RAFT_TPU_CACHE_DIR for air-gapped/cluster layouts.
+    """
+    root = os.environ.get("RAFT_TPU_CACHE_DIR")
+    if not root:
+        root = os.path.join(tempfile.gettempdir(),
+                            f"raft_tpu_cache_{os.getuid()}")
+    return os.path.join(root, f"jax_{tag}")
+
+
+def enable_persistent_cache(tag: str) -> None:
+    """Point jax's compilation cache at :func:`jax_cache_dir` with
+    every-entry persistence (the remote-TPU compiles this repo cares
+    about are multi-minute; cache everything)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", jax_cache_dir(tag))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
 def respect_cpu_request() -> None:

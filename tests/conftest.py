@@ -32,7 +32,9 @@ except Exception:
     pass
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", "/tmp/raft_tpu_jax_cache")
+from raft_tpu.utils.platform import jax_cache_dir  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", jax_cache_dir("cputest"))
 # Golden-parity tests compare against torch fp32 oracles; this XLA CPU build
 # lowers conv/dot to a reduced-precision path by default (observed ~1e-1 abs
 # drift vs torch on a 3x3 conv), so force true fp32 accumulation under test.

@@ -119,6 +119,19 @@ class TestCorrDtypeBf16:
         np.testing.assert_allclose(got_oh, want, atol=1e-5, rtol=1e-5)
         np.testing.assert_allclose(got_pl, want, atol=1e-5, rtol=1e-5)
 
+    def test_onehot_bf16_selection_is_bit_exact(self, setup):
+        """The bf16 fast path (bf16 one-hots, default MXU precision) must
+        equal fp32 selection of the same bf16 volume BIT-exactly: each
+        output is one volume entry times 1.0 plus zeros, and the lerp runs
+        fp32 in both cases. Guards the precision dispatch in
+        corr_lookup_onehot against 'simplifying' it back to one path."""
+        pyramid, coords = setup
+        pyr16 = tuple(v.astype(jnp.bfloat16) for v in pyramid)
+        fast = np.asarray(corr_lookup_onehot(pyr16, coords, RADIUS))
+        slow = np.asarray(corr_lookup_onehot(
+            tuple(v.astype(jnp.float32) for v in pyr16), coords, RADIUS))
+        np.testing.assert_array_equal(fast, slow)
+
     def test_bf16_drift_is_storage_rounding(self, setup):
         pyramid, coords = setup
         pyr16 = tuple(v.astype(jnp.bfloat16) for v in pyramid)

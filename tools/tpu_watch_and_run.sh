@@ -1,0 +1,18 @@
+#!/bin/bash
+# Poll the axon TPU tunnel every 5 minutes; when it answers, run the
+# follow-up on-chip runbook once and exit. Survives tunnel claim-wait
+# hangs via a per-probe timeout.
+set -u
+cd /root/repo
+OUT=${1:-/tmp/onchip_round2b.out}
+LOG=/tmp/tpu_watch.log
+while true; do
+    if timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        echo "$(date -u +%H:%M:%S) chip up — launching round2b" >> "$LOG"
+        bash /root/repo/tools/onchip_round2b.sh "$OUT"
+        echo "$(date -u +%H:%M:%S) round2b done" >> "$LOG"
+        exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) chip unavailable" >> "$LOG"
+    sleep 300
+done
