@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
 
@@ -67,7 +68,7 @@ def is_oom(exc: Exception) -> bool:
     if "scoped vmem" in s or "memory space vmem" in s:
         return False
     return ("resource_exhausted" in s or "out of memory" in s
-            or "oom" in s)
+            or re.search(r"\boom\b", s) is not None)
 
 
 def build(batch_size, remat, overrides):

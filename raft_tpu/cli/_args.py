@@ -13,10 +13,10 @@ import argparse
 
 
 def add_corr_args(p: argparse.ArgumentParser) -> None:
-    p.add_argument("--corr_impl", default=None,
+    p.add_argument("--corr_impl", "--corr-impl", default=None,
                    choices=["gather", "onehot", "pallas"],
                    help="lookup backend override (default: RAFTConfig's)")
-    p.add_argument("--corr_dtype", default=None,
+    p.add_argument("--corr_dtype", "--corr-dtype", default=None,
                    choices=["float32", "bfloat16"],
                    help="correlation-pyramid storage dtype; 'bfloat16' "
                         "halves volume traffic (see RAFTConfig.corr_dtype)")

@@ -22,6 +22,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.cli._args import add_corr_args, corr_overrides
+
 
 def main(argv=None):
     from raft_tpu.utils.platform import respect_cpu_request
@@ -34,8 +36,6 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)  # min 1: force() reads
     # the warmup loop's metrics; clamped below
-    from raft_tpu.cli._args import add_corr_args
-
     add_corr_args(p)
     p.add_argument("--remat", action="store_true")
     p.add_argument("--fp32", action="store_true",
@@ -53,8 +53,6 @@ def main(argv=None):
     from raft_tpu.config import RAFTConfig, stage_config
     from raft_tpu.training.train_step import (create_train_state,
                                               make_train_step)
-
-    from raft_tpu.cli._args import corr_overrides
 
     overrides = corr_overrides(args)
     model_cfg = RAFTConfig(small=False, mixed_precision=not args.fp32,

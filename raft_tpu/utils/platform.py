@@ -17,16 +17,28 @@ import tempfile
 def jax_cache_dir(tag: str) -> str:
     """Per-user persistent-compile-cache dir for ``tag`` (e.g. 'tpu').
 
-    Per-user because the cache holds trusted serialized executables at a
-    guessable path — a world-shared /tmp name would let another local
-    user pre-plant entries (and breaks with permission errors anyway).
-    Override with RAFT_TPU_CACHE_DIR for air-gapped/cluster layouts.
+    The cache holds trusted serialized executables, so a predictable
+    world-writable location would let another local user pre-plant
+    entries. Defaults under ``~/.cache``; the directory is created 0700
+    and its ownership verified (a guessable name alone is not enough —
+    an attacker could pre-create it). Override with RAFT_TPU_CACHE_DIR
+    for air-gapped/cluster layouts.
     """
     root = os.environ.get("RAFT_TPU_CACHE_DIR")
     if not root:
-        root = os.path.join(tempfile.gettempdir(),
-                            f"raft_tpu_cache_{os.getuid()}")
-    return os.path.join(root, f"jax_{tag}")
+        root = os.path.join(
+            os.path.expanduser("~/.cache") if os.path.expanduser("~") != "~"
+            else tempfile.gettempdir(), "raft_tpu")
+    path = os.path.join(root, f"jax_{tag}")
+    os.makedirs(path, mode=0o700, exist_ok=True)
+    st = os.stat(path)
+    if st.st_uid != os.getuid():
+        raise RuntimeError(
+            f"compile-cache dir {path} is owned by uid {st.st_uid}, not "
+            f"{os.getuid()} — refusing to load serialized executables "
+            "from it; set RAFT_TPU_CACHE_DIR to a directory you own")
+    os.chmod(path, 0o700)
+    return path
 
 
 def enable_persistent_cache(tag: str) -> None:

@@ -31,5 +31,7 @@ timeout 2400 python bench.py --steps 10 --batches 6 \
 log "5 profile_step trace with the onehot default"
 timeout 2400 python -m raft_tpu.cli.profile_step --batch 6 --steps 10 \
     --trace-dir /tmp/raft_trace_onehot >> "$OUT" 2>&1
+timeout 1200 python -m raft_tpu.cli.trace_summary /tmp/raft_trace_onehot \
+    --top 30 >> "$OUT" 2>&1
 
 log "done"

@@ -7,7 +7,7 @@ cd /root/repo
 OUT=${1:-/tmp/onchip_round2b.out}
 LOG=/tmp/tpu_watch.log
 while true; do
-    if timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    if timeout 180 python -c "import jax; d = jax.devices(); assert d[0].platform != 'cpu', d" >/dev/null 2>&1; then
         echo "$(date -u +%H:%M:%S) chip up — launching round2b" >> "$LOG"
         bash /root/repo/tools/onchip_round2b.sh "$OUT"
         echo "$(date -u +%H:%M:%S) round2b done" >> "$LOG"
