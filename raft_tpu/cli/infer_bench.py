@@ -1,0 +1,85 @@
+"""Inference-throughput benchmark (the serving-side number).
+
+The reference's only serving benchmark is ``test_trt.py:74-97`` — wall
+clock around a PyTorch and a TensorRT forward with explicit synchronize
+fences. This is that harness for the TPU serving path: the jitted
+test-mode forward (what ``serving/engine.py`` buckets compile) timed with
+the repo's honest remote-backend scheme (`utils/timing.py`): the iteration
+loop runs inside ONE executable chained through an input nudge, weights
+and images ride as jit arguments, and a single scalar fetch fences.
+
+Run on the real chip:
+    python -m raft_tpu.cli.infer_bench --hw 440 1024   # cvt2trt opt-ish
+    python -m raft_tpu.cli.infer_bench --hw 368 496 --batch 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def main(argv=None):
+    from raft_tpu.utils.platform import (enable_persistent_cache,
+                                         respect_cpu_request)
+
+    respect_cpu_request()
+    enable_persistent_cache("tpu")
+    p = argparse.ArgumentParser(description="serving forward throughput")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--hw", type=int, nargs=2, default=[440, 1024],
+                   help="input H W (divisible by 8); default near the "
+                        "cvt2trt.sh opt shape")
+    p.add_argument("--iters", type=int, default=20,
+                   help="refinement iterations (export bakes 20)")
+    p.add_argument("--reps", type=int, default=10,
+                   help="timed forwards inside the chained executable")
+    p.add_argument("--small", action="store_true")
+    from raft_tpu.cli._args import add_corr_args, corr_overrides
+
+    add_corr_args(p)
+    args = p.parse_args(argv)
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.utils.timing import chain_timed
+
+    cfg = RAFTConfig(small=args.small, **corr_overrides(args))
+    model = RAFT(cfg)
+    H, W = args.hw
+    rng = np.random.RandomState(0)
+    img = jnp.asarray(rng.rand(args.batch, H, W, 3).astype(np.float32) * 255)
+    # params are shape-independent: init tiny (the benchmark shape would
+    # run hundreds of eager full-resolution dispatches over the tunnel)
+    tiny = jnp.zeros((1, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), tiny, tiny, iters=1)
+
+    def forward(image1, invars):
+        variables, image2 = invars
+        _, up = model.apply(variables, image1, image2, iters=args.iters,
+                            test_mode=True)
+        return up
+
+    dt = chain_timed(forward, img, args.reps, (variables, img))
+    pairs_per_s = args.batch / dt
+    tag = "small" if args.small else "basic"
+    suffix = "".join(
+        f"_{v}" for v in (args.corr_impl,
+                          f"corr{args.corr_dtype}" if args.corr_dtype
+                          else None) if v)
+    print(json.dumps({
+        "metric": f"raft_{tag}_infer_{H}x{W}_b{args.batch}"
+                  f"_iters{args.iters}{suffix}",
+        "value": round(pairs_per_s, 3),
+        "unit": "img_pairs_per_sec",
+        "ms_per_forward": round(dt * 1e3, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

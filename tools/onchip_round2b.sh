@@ -34,4 +34,9 @@ timeout 2400 python -m raft_tpu.cli.profile_step --batch 6 --steps 10 \
 timeout 1200 python -m raft_tpu.cli.trace_summary /tmp/raft_trace_onehot \
     --top 30 >> "$OUT" 2>&1
 
+log "6 inference throughput (serving forward, test_trt.py timing analog)"
+timeout 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024 >> "$OUT" 2>&1
+timeout 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024 \
+    --corr_dtype bfloat16 >> "$OUT" 2>&1
+
 log "done"
