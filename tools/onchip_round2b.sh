@@ -1,40 +1,42 @@
 #!/bin/bash
 # Follow-up on-chip runbook (round 2, session B) — run after
-# tools/onchip_runbook.sh. Validates the two kernel fixes that came out of
-# the first session's failures (scoped-VMEM tiling, 8-aligned alt DMA) and
-# finishes the measurement program with the onehot default.
+# tools/onchip_runbook.sh. Ordered by value-per-minute: chip windows have
+# been ~100 min, so the headline-affecting measurements (batch ladder,
+# bf16 volume, trace) come before the informational kernel shootouts.
 set -u
 cd /root/repo
 OUT=${1:-/tmp/onchip_round2b.out}
 log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
 
-log "1 corr_bench chairs fwd+grad, pallas vs onehot (post scoped-VMEM fix)"
-timeout 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
-    --iters 20 --impls onehot pallas >> "$OUT" 2>&1
-timeout 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
-    --iters 20 --impls onehot pallas --grad >> "$OUT" 2>&1
-
-log "2 corr_bench alt_pallas (post alignment fix), chairs + 128x128"
-timeout 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
-    --iters 20 --impls alt alt_pallas >> "$OUT" 2>&1
-timeout 2400 python -m raft_tpu.cli.corr_bench --batch 1 --hw 128 128 \
-    --iters 10 --impls alt alt_pallas >> "$OUT" 2>&1
-
-log "3 bench.py batch ladder with the onehot default (b8 first)"
+log "1 bench.py batch ladder, onehot default (b8 first, b6 fallback)"
 timeout 2400 python bench.py --steps 10 --batches 8 6 >> "$OUT" 2>&1
-timeout 2400 python bench.py --steps 10 --batches 8 6 --remat >> "$OUT" 2>&1
 
-log "4 bench.py corr_dtype=bfloat16 (halved volume traffic)"
-timeout 2400 python bench.py --steps 10 --batches 6 \
+log "2 bench.py corr_dtype=bfloat16 (halved volume traffic)"
+timeout 2400 python bench.py --steps 10 --batches 8 6 \
     --corr-dtype bfloat16 >> "$OUT" 2>&1
 
-log "5 profile_step trace with the onehot default"
+log "3 profile_step trace with the onehot default"
 timeout 2400 python -m raft_tpu.cli.profile_step --batch 6 --steps 10 \
     --trace-dir /tmp/raft_trace_onehot >> "$OUT" 2>&1
 timeout 1200 python -m raft_tpu.cli.trace_summary /tmp/raft_trace_onehot \
     --top 30 >> "$OUT" 2>&1
 
-log "6 inference throughput (serving forward, test_trt.py timing analog)"
+log "4 bench.py remat variant (memory headroom for bigger batches)"
+timeout 2400 python bench.py --steps 10 --batches 10 8 --remat >> "$OUT" 2>&1
+
+log "5 corr_bench chairs fwd+grad, pallas vs onehot (post scoped-VMEM fix)"
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls onehot pallas >> "$OUT" 2>&1
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls onehot pallas --grad >> "$OUT" 2>&1
+
+log "6 corr_bench alt_pallas (post alignment fix), chairs + 128x128"
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls alt alt_pallas >> "$OUT" 2>&1
+timeout 2400 python -m raft_tpu.cli.corr_bench --batch 1 --hw 128 128 \
+    --iters 10 --impls alt alt_pallas >> "$OUT" 2>&1
+
+log "7 inference throughput (serving forward, test_trt.py timing analog)"
 timeout 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024 >> "$OUT" 2>&1
 timeout 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024 \
     --corr_dtype bfloat16 >> "$OUT" 2>&1
