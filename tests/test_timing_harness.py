@@ -68,3 +68,31 @@ def test_chain_timed_runs_and_returns_positive():
     dt = chain_timed(lambda x: x * 1.5, jnp.ones((8, 8), jnp.float32),
                      iters=2)
     assert dt > 0.0 and np.isfinite(dt)
+
+
+def test_invariants_lower_as_parameters_not_constants():
+    """Arrays the timed fn reads must ride as jit parameters. A closure
+    would embed them in the HLO as literal constants — on the remote TPU
+    backend a large embedded operand is rejected outright by the compile
+    endpoint (HTTP 413 at ~750 MB observed on-chip), and it bloats every
+    upload before that. Pinned at the lowered-HLO level: a 4 MB invariant
+    must not appear in the program text."""
+    # random data: a constant-foldable pattern (ones, iota) would lower
+    # as a broadcast/iota and dodge the embedding either way
+    big = jnp.asarray(
+        np.random.RandomState(0).rand(1 << 20).astype(np.float32))  # 4 MB
+    scanned = chained_scan(lambda c, v: jnp.sum(v) * c, iters=2)
+    txt = scanned.lower(jnp.float32(1.0), big).as_text()
+    assert len(txt) < 100_000, (
+        f"invariant embedded as an HLO constant ({len(txt)} bytes of "
+        "program text) — it must be a parameter")
+
+    # counter-test: the closure form really does embed it (big * c keeps
+    # the array in the graph — a concrete-only expression like
+    # jnp.sum(big) would constant-fold to a scalar during tracing)
+    closed = chained_scan(lambda c: big * c, iters=2)
+    txt_closed = closed.lower(jnp.float32(1.0)).as_text()
+    assert len(txt_closed) > 1_000_000, (
+        "XLA stopped embedding closure constants; the invariants "
+        "machinery may no longer be necessary (harmless, but re-check "
+        "timing.py's rationale)")
