@@ -46,7 +46,7 @@ from raft_tpu.utils.platform import enable_persistent_cache  # noqa: E402
 enable_persistent_cache("tpu")
 
 BASELINE_PAIRS_PER_SEC = 20.0  # est. 2xV100 reference recipe (see docstring)
-IMAGE_HW = (368, 496)          # train_standard.sh chairs crop
+IMAGE_HW = (368, 496)          # train_standard.sh chairs crop (--hw overrides)
 ITERS = 12                     # train.py:232
 
 START = time.monotonic()
@@ -71,7 +71,7 @@ def is_oom(exc: Exception) -> bool:
             or re.search(r"\boom\b", s) is not None)
 
 
-def build(batch_size, remat, overrides):
+def build(batch_size, remat, overrides, image_hw=IMAGE_HW):
     from raft_tpu.config import RAFTConfig, stage_config
     from raft_tpu.training.train_step import (create_train_state,
                                               make_train_step)
@@ -80,10 +80,10 @@ def build(batch_size, remat, overrides):
                            **overrides)
     train_cfg = stage_config("chairs", batch_size=batch_size)
     rng = jax.random.PRNGKey(0)
-    state = create_train_state(model_cfg, train_cfg, rng, image_hw=IMAGE_HW)
+    state = create_train_state(model_cfg, train_cfg, rng, image_hw=image_hw)
     step = jax.jit(make_train_step(model_cfg, train_cfg), donate_argnums=(0,))
 
-    h, w = IMAGE_HW
+    h, w = image_hw
     host = np.random.RandomState(0)
     batch = {
         "image1": jnp.asarray(
@@ -97,11 +97,12 @@ def build(batch_size, remat, overrides):
     return state, step, batch, rng
 
 
-def run(batch_size, remat, warmup, steps, overrides):
+def run(batch_size, remat, warmup, steps, overrides, image_hw=IMAGE_HW):
     from raft_tpu.utils.timing import force_train as force
     warmup, steps = max(1, warmup), max(1, steps)  # force() needs metrics
-    log(f"building batch={batch_size} remat={remat} overrides={overrides}")
-    state, step, batch, rng = build(batch_size, remat, overrides)
+    log(f"building batch={batch_size} remat={remat} hw={image_hw} "
+        f"overrides={overrides}")
+    state, step, batch, rng = build(batch_size, remat, overrides, image_hw)
     log("compiling + warmup")
     for _ in range(warmup):
         state, metrics = step(state, batch, rng)
@@ -139,14 +140,23 @@ def main():
     p.add_argument("--corr-dtype", default=None,
                    help="override RAFTConfig.corr_dtype (bfloat16 halves "
                         "volume traffic; fp32 is reference parity)")
+    p.add_argument("--hw", type=int, nargs=2, default=list(IMAGE_HW),
+                   help="crop H W (divisible by 8); defaults to the "
+                        "chairs-stage crop, e.g. 400 720 for things")
     args = p.parse_args()
+    if args.hw[0] % 8 or args.hw[1] % 8:
+        p.error(f"--hw {args.hw[0]} {args.hw[1]}: both must be divisible "
+                "by 8 (catch it here, not after a multi-minute compile)")
+    h, w = args.hw
+    stage = "chairs_" if (h, w) == IMAGE_HW else ""
+    shape_tag = f"{stage}{h}x{w}"
 
     try:
         devs = jax.devices()
         log(f"devices: {devs}")
     except Exception as exc:
         log(f"backend init failed: {exc}")
-        emit("raft_basic_train_chairs_368x496_backend_init_failed", 0.0)
+        emit(f"raft_basic_train_{shape_tag}_backend_init_failed", 0.0)
         return 1
 
     last_err = None
@@ -161,7 +171,7 @@ def main():
             overrides["corr_dtype"] = args.corr_dtype
         try:
             value = run(batch_size, args.remat, args.warmup, args.steps,
-                        overrides)
+                        overrides, tuple(args.hw))
         except Exception as exc:
             last_err = exc
             if is_oom(exc):
@@ -174,12 +184,12 @@ def main():
             tag += f"_{args.corr_impl}"
         if args.corr_dtype:
             tag += f"_corr{args.corr_dtype}"
-        emit(f"raft_basic_train_chairs_368x496_bf16_b{batch_size}"
+        emit(f"raft_basic_train_{shape_tag}_bf16_b{batch_size}"
              f"_iters{ITERS}_1chip{tag}", value)
         return 0
 
     log(f"no successful run; last error: {last_err}")
-    emit("raft_basic_train_chairs_368x496_failed", 0.0)
+    emit(f"raft_basic_train_{shape_tag}_failed", 0.0)
     return 1
 
 
