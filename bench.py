@@ -131,6 +131,9 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batches", type=int, nargs="+", default=[6, 4, 2])
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", default=None, choices=["full", "dots"],
+                   help="remat granularity (with --remat); 'dots' saves "
+                        "conv/GEMM outputs, recomputes elementwise")
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--deadline-s", type=float, default=2400.0,
@@ -144,6 +147,9 @@ def main():
                    help="crop H W (divisible by 8); defaults to the "
                         "chairs-stage crop, e.g. 400 720 for things")
     args = p.parse_args()
+    if args.remat_policy and not args.remat:
+        p.error("--remat-policy requires --remat (without it the policy "
+                "is a silent no-op and the run measures a baseline step)")
     if args.hw[0] % 8 or args.hw[1] % 8:
         p.error(f"--hw {args.hw[0]} {args.hw[1]}: both must be divisible "
                 "by 8 (catch it here, not after a multi-minute compile)")
@@ -169,6 +175,8 @@ def main():
             overrides["corr_impl"] = args.corr_impl
         if args.corr_dtype:
             overrides["corr_dtype"] = args.corr_dtype
+        if args.remat_policy:
+            overrides["remat_policy"] = args.remat_policy
         try:
             value = run(batch_size, args.remat, args.warmup, args.steps,
                         overrides, tuple(args.hw))
@@ -180,6 +188,8 @@ def main():
             log(f"fatal (non-OOM): {type(exc).__name__}: {exc}")
             break
         tag = "_remat" if args.remat else ""
+        if args.remat and args.remat_policy == "dots":
+            tag += "dots"
         if args.corr_impl:
             tag += f"_{args.corr_impl}"
         if args.corr_dtype:

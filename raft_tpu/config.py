@@ -70,6 +70,12 @@ class RAFTConfig:
     # (observed ~1.5 GB/buffer at chairs shapes), the jax.checkpoint lever
     # HBM-bound training wants (SURVEY.md §7 "HBM bandwidth")
     remat: bool = False
+    # remat granularity when remat=True: 'full' recomputes the whole
+    # iteration body; 'dots' (jax.checkpoint_policies.checkpoint_dots)
+    # saves matmul/conv outputs and recomputes only elementwise — most of
+    # the memory win at a fraction of the recompute, since the body is
+    # conv/GEMM-dominated
+    remat_policy: str = "full"
 
     def __post_init__(self):
         if self.corr_impl not in ("gather", "onehot", "pallas"):
@@ -78,6 +84,10 @@ class RAFTConfig:
                 "pallas (the memory-efficient alternate path is selected "
                 "by alternate_corr=True, with corr_impl picking its "
                 "XLA/pallas backend)")
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy={self.remat_policy!r}: choose 'full' or "
+                "'dots'")
         if self.corr_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"corr_dtype={self.corr_dtype!r}: choose 'float32' "

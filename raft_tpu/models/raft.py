@@ -157,7 +157,12 @@ class RAFT(nn.Module):
                 flow_up = convex_upsample(new_flow, up_mask)
             return (net, coords1), flow_up
 
-        body = nn.remat(_iteration) if cfg.remat else _iteration
+        if cfg.remat:
+            policy = (jax.checkpoint_policies.checkpoint_dots
+                      if cfg.remat_policy == "dots" else None)
+            body = nn.remat(_iteration, policy=policy)
+        else:
+            body = _iteration
         scan = nn.scan(
             body,
             variable_broadcast="params",

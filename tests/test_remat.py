@@ -17,14 +17,18 @@ class TestRemat:
         from raft_tpu.models import RAFT
 
         outs = {}
-        for remat in (False, True):
-            model = RAFT(RAFTConfig(small=True, remat=remat))
+        for key, kw in (("off", dict(remat=False)),
+                        ("full", dict(remat=True)),
+                        ("dots", dict(remat=True, remat_policy="dots"))):
+            model = RAFT(RAFTConfig(small=True, **kw))
             variables = model.init(jax.random.PRNGKey(0), img1, img2,
                                    iters=1)
             _, up = model.apply(variables, img1, img2, iters=3,
                                 test_mode=True)
-            outs[remat] = np.asarray(up)
-        np.testing.assert_allclose(outs[True], outs[False], atol=1e-5,
+            outs[key] = np.asarray(up)
+        np.testing.assert_allclose(outs["full"], outs["off"], atol=1e-5,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(outs["dots"], outs["off"], atol=1e-5,
                                    rtol=1e-5)
 
     def test_train_step_with_remat(self, rng):

@@ -21,7 +21,9 @@ timeout 2400 python -m raft_tpu.cli.profile_step --batch 6 --steps 10 \
 timeout 1200 python -m raft_tpu.cli.trace_summary /tmp/raft_trace_onehot \
     --top 30 >> "$OUT" 2>&1
 
-log "4 bench.py remat variant (memory headroom for bigger batches)"
+log "4 bench.py remat variants (memory headroom for bigger batches)"
+timeout 2400 python bench.py --steps 10 --batches 10 8 --remat \
+    --remat-policy dots >> "$OUT" 2>&1
 timeout 2400 python bench.py --steps 10 --batches 10 8 --remat >> "$OUT" 2>&1
 
 log "5 corr_bench chairs fwd+grad, pallas vs onehot (post scoped-VMEM fix)"
