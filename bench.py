@@ -40,10 +40,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# persistent compile cache: repeat bench runs skip the multi-minute compile
-from raft_tpu.utils.platform import enable_persistent_cache  # noqa: E402
+# honor JAX_PLATFORMS=cpu + persistent compile cache (multi-minute
+# remote compiles are skipped on repeat runs)
+from raft_tpu.utils.platform import setup_cli  # noqa: E402
 
-enable_persistent_cache("tpu")
+setup_cli()
 
 BASELINE_PAIRS_PER_SEC = 20.0  # est. 2xV100 reference recipe (see docstring)
 IMAGE_HW = (368, 496)          # train_standard.sh chairs crop (--hw overrides)
@@ -188,8 +189,8 @@ def main():
             log(f"fatal (non-OOM): {type(exc).__name__}: {exc}")
             break
         tag = "_remat" if args.remat else ""
-        if args.remat and args.remat_policy == "dots":
-            tag += "dots"
+        if args.remat_policy == "dots":  # parse guard implies --remat
+            tag += "_dots"
         if args.corr_impl:
             tag += f"_{args.corr_impl}"
         if args.corr_dtype:

@@ -41,9 +41,9 @@ def bench_fn(fn, coords, vols, iters=20):
 
 
 def main(argv=None):
-    from raft_tpu.utils.platform import respect_cpu_request
+    from raft_tpu.utils.platform import setup_cli
 
-    respect_cpu_request()
+    setup_cli()
     p = argparse.ArgumentParser(description="corr lookup backend shootout")
     p.add_argument("--batch", type=int, default=6)
     p.add_argument("--hw", type=int, nargs=2, default=[46, 62],

@@ -13,6 +13,9 @@ from raft_tpu.config import RAFTConfig
 
 
 def main(argv=None):
+    from raft_tpu.utils.platform import setup_cli
+
+    setup_cli()
     p = argparse.ArgumentParser(description="RAFT 4-stage curriculum on TPU")
     p.add_argument("--name", default="raft")
     p.add_argument("--small", action="store_true")

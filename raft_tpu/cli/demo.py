@@ -31,6 +31,9 @@ def load_image(path: str) -> jnp.ndarray:
 
 
 def main(argv=None):
+    from raft_tpu.utils.platform import setup_cli
+
+    setup_cli()
     p = argparse.ArgumentParser(description="RAFT demo on a frame directory")
     p.add_argument("--model", required=True, help=".pth or .msgpack weights")
     p.add_argument("--path", required=True, help="directory of frames")

@@ -33,9 +33,9 @@ def scan_time(name, body, x0, iters=20, work=None, unit="T/s"):
 
 
 def main(argv=None):
-    from raft_tpu.utils.platform import respect_cpu_request
+    from raft_tpu.utils.platform import setup_cli
 
-    respect_cpu_request()
+    setup_cli()
     p = argparse.ArgumentParser()
     p.add_argument("--iters", type=int, default=20)
     args = p.parse_args(argv)

@@ -9,6 +9,9 @@ from raft_tpu.config import RAFTConfig
 
 
 def main(argv=None):
+    from raft_tpu.utils.platform import setup_cli
+
+    setup_cli()
     p = argparse.ArgumentParser(description="Validate RAFT checkpoints")
     p.add_argument("--model", required=True, help=".pth or .msgpack weights")
     p.add_argument("--dataset", required=True,

@@ -25,11 +25,9 @@ import jax.numpy as jnp
 
 
 def main(argv=None):
-    from raft_tpu.utils.platform import (enable_persistent_cache,
-                                         respect_cpu_request)
+    from raft_tpu.utils.platform import setup_cli
 
-    respect_cpu_request()
-    enable_persistent_cache("tpu")
+    setup_cli()
     p = argparse.ArgumentParser(description="serving forward throughput")
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--hw", type=int, nargs=2, default=[440, 1024],

@@ -25,6 +25,9 @@ from raft_tpu.ops.padding import InputPadder
 
 
 def main(argv=None):
+    from raft_tpu.utils.platform import setup_cli
+
+    setup_cli()
     p = argparse.ArgumentParser(description="jit vs AOT-engine parity")
     p.add_argument("--model", required=True, help=".pth or .msgpack weights")
     p.add_argument("--path", required=True, help="directory of frames")

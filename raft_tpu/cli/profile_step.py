@@ -26,9 +26,9 @@ from raft_tpu.cli._args import add_corr_args, corr_overrides
 
 
 def main(argv=None):
-    from raft_tpu.utils.platform import respect_cpu_request
+    from raft_tpu.utils.platform import setup_cli
 
-    respect_cpu_request()
+    setup_cli()
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=6)
     p.add_argument("--hw", type=int, nargs=2, default=[368, 496])
@@ -46,9 +46,6 @@ def main(argv=None):
     args.warmup = max(1, args.warmup)
     args.steps = max(1, args.steps)
 
-    from raft_tpu.utils.platform import enable_persistent_cache
-
-    enable_persistent_cache("tpu")
 
     from raft_tpu.config import RAFTConfig, stage_config
     from raft_tpu.training.train_step import (create_train_state,

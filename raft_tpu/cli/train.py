@@ -73,6 +73,9 @@ def configs_from_args(args) -> tuple[RAFTConfig, TrainConfig]:
 
 
 def main(argv=None):
+    from raft_tpu.utils.platform import setup_cli
+
+    setup_cli()
     args = build_parser().parse_args(argv)
     from raft_tpu.training.trainer import train
 

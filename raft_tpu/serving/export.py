@@ -63,6 +63,10 @@ def load_stablehlo(blob: bytes):
 def main(argv=None):
     import argparse
 
+    from raft_tpu.utils.platform import setup_cli
+
+    setup_cli()
+
     p = argparse.ArgumentParser(
         description="Export RAFT to portable StableHLO")
     p.add_argument("--model", required=True, help=".pth or .msgpack weights")

@@ -52,6 +52,14 @@ def enable_persistent_cache(tag: str) -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
+def setup_cli(tag: str = "tpu") -> None:
+    """Standard CLI preamble: honor JAX_PLATFORMS=cpu (never dial the
+    tunnel) and enable the persistent compile cache. One call per
+    entrypoint, so a grep for setup_cli audits the sweep."""
+    respect_cpu_request()
+    enable_persistent_cache(tag)
+
+
 def respect_cpu_request() -> None:
     """If JAX_PLATFORMS=cpu, make sure the axon plugin can't be dialed."""
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
