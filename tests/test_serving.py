@@ -60,6 +60,26 @@ class TestEngine:
         assert flow.shape == (1, 40, 40, 2)
         assert (1, 40, 40) in eng._compiled
 
+    def test_weight_hotswap_reuses_executables(self, small_setup, rng):
+        """Weights are executable ARGUMENTS: a checkpoint swap must change
+        the output without invalidating (or recompiling) any bucket."""
+        cfg, variables = small_setup
+        eng = RAFTEngine(variables, cfg, iters=2, envelope=[(1, 64, 64)])
+        exe_before = eng._compiled[(1, 64, 64)]
+        img = rng.rand(1, 64, 64, 3).astype(np.float32) * 255
+        img2 = rng.rand(1, 64, 64, 3).astype(np.float32) * 255
+        flow_a = eng.infer_batch(img, img2)
+
+        scaled = jax.tree_util.tree_map(lambda p: p * 1.5, variables)
+        eng.update_weights(scaled)
+        flow_b = eng.infer_batch(img, img2)
+        assert eng._compiled[(1, 64, 64)] is exe_before, "recompiled"
+        assert np.abs(flow_a - flow_b).max() > 1e-4, (
+            "new weights did not change the output")
+
+        with pytest.raises(ValueError, match="structure mismatch"):
+            eng.update_weights({"params": {}})
+
     def test_sliding_window_sequence(self, small_setup, rng):
         cfg, variables = small_setup
         eng = RAFTEngine(variables, cfg, iters=2, envelope=[(2, 64, 64)])
