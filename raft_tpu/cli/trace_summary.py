@@ -20,6 +20,14 @@ import os
 from collections import defaultdict
 
 
+def parse_gviz(d: dict) -> list:
+    """gviz table ({cols: [{id}], rows: [{c: [{v}]}]}) -> list of row dicts."""
+    cols = [c["id"] for c in d["cols"]]
+    rows = [[cell["v"] if isinstance(cell, dict) else cell
+             for cell in r["c"]] for r in d.get("rows", [])]
+    return [dict(zip(cols, r)) for r in rows]
+
+
 def _load_hlo_stats(trace_dir: str):
     paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                              recursive=True))
@@ -30,10 +38,45 @@ def _load_hlo_stats(trace_dir: str):
     data = convert.xspace_to_tool_data(paths, "hlo_stats", {})
     out = data[0] if isinstance(data, tuple) else data
     d = json.loads(out if isinstance(out, str) else out.decode())
-    cols = [c["id"] for c in d["cols"]]
-    rows = [[cell["v"] if isinstance(cell, dict) else cell
-             for cell in r["c"]] for r in d.get("rows", [])]
-    return paths, cols, [dict(zip(cols, r)) for r in rows]
+    return paths, parse_gviz(d)
+
+
+def _num(v):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def report(rows: list, top: int) -> None:
+    """Print the category rollup + top-N op table for hlo_stats rows."""
+    if not rows:
+        print("no device op rows — was the trace captured on an "
+              "accelerator with device tracing enabled?")
+        return
+
+    by_cat = defaultdict(lambda: [0.0, 0])
+    total = 0.0
+    for r in rows:
+        t = _num(r.get("total_self_time"))
+        by_cat[r.get("category", "?")][0] += t
+        by_cat[r.get("category", "?")][1] += int(_num(r.get("occurrences")))
+        total += t
+    print(f"\n== self time by HLO category (total {total:,.0f} us) ==")
+    for cat, (t, n) in sorted(by_cat.items(), key=lambda kv: -kv[1][0]):
+        print(f"{t / max(total, 1e-9) * 100:6.1f}%  {t:12,.0f} us  "
+              f"x{n:<7d} {cat}")
+
+    print(f"\n== top {top} ops by self time ==")
+    rows = sorted(rows, key=lambda r: -_num(r.get("total_self_time")))
+    for r in rows[:top]:
+        name = str(r.get("hlo_op_name", "?"))[:48]
+        print(f"{_num(r.get('total_self_time_percent')):6.2f}%  "
+              f"{_num(r.get('total_self_time')):10,.0f} us  "
+              f"x{int(_num(r.get('occurrences'))):<6d} "
+              f"{str(r.get('bound_by', '?')):>8s}  "
+              f"bw {_num(r.get('measured_memory_bw')):7.1f} GB/s  "
+              f"{str(r.get('category', ''))[:18]:18s} {name}")
 
 
 def main(argv=None):
@@ -42,42 +85,9 @@ def main(argv=None):
     p.add_argument("--top", type=int, default=20)
     args = p.parse_args(argv)
 
-    paths, cols, rows = _load_hlo_stats(args.trace_dir)
+    paths, rows = _load_hlo_stats(args.trace_dir)
     print(f"trace: {len(paths)} xplane file(s), {len(rows)} HLO op rows")
-    if not rows:
-        print("no device op rows — was the trace captured on an "
-              "accelerator with device tracing enabled?")
-        return
-
-    def num(v):
-        try:
-            return float(v)
-        except (TypeError, ValueError):
-            return 0.0
-
-    # category rollup
-    by_cat = defaultdict(lambda: [0.0, 0])
-    total = 0.0
-    for r in rows:
-        t = num(r.get("total_self_time"))
-        by_cat[r.get("category", "?")][0] += t
-        by_cat[r.get("category", "?")][1] += int(num(r.get("occurrences")))
-        total += t
-    print(f"\n== self time by HLO category (total {total:,.0f} us) ==")
-    for cat, (t, n) in sorted(by_cat.items(), key=lambda kv: -kv[1][0]):
-        print(f"{t / max(total, 1e-9) * 100:6.1f}%  {t:12,.0f} us  "
-              f"x{n:<7d} {cat}")
-
-    print(f"\n== top {args.top} ops by self time ==")
-    rows.sort(key=lambda r: -num(r.get("total_self_time")))
-    for r in rows[:args.top]:
-        name = str(r.get("hlo_op_name", "?"))[:48]
-        print(f"{num(r.get('total_self_time_percent')):6.2f}%  "
-              f"{num(r.get('total_self_time')):10,.0f} us  "
-              f"x{int(num(r.get('occurrences'))):<6d} "
-              f"{str(r.get('bound_by', '?')):>8s}  "
-              f"bw {num(r.get('measured_memory_bw')):7.1f} GB/s  "
-              f"{str(r.get('category', ''))[:18]:18s} {name}")
+    report(rows, args.top)
 
 
 if __name__ == "__main__":

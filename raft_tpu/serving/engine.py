@@ -77,19 +77,19 @@ class RAFTEngine:
         checkpoint into a small-config engine, or bf16-cast weights)
         would brick every precompiled bucket with an opaque call-time
         error if it slipped through here."""
-        def aval(tree):
-            return jax.tree_util.tree_map(
-                lambda l: (jnp.shape(l), jnp.result_type(l)), tree)
+        def avals(tree):
+            return {jax.tree_util.keystr(k): (jnp.shape(l),
+                                              jnp.result_type(l))
+                    for k, l in
+                    jax.tree_util.tree_flatten_with_path(tree)[0]}
 
-        old, new = aval(self.variables), aval(variables)
+        old, new = avals(self.variables), avals(variables)
         if old != new:
-            diff = [
-                f"{jax.tree_util.keystr(k)}: {n} vs engine's {o}"
-                for (k, n), (_, o) in zip(
-                    jax.tree_util.tree_flatten_with_path(new)[0],
-                    jax.tree_util.tree_flatten_with_path(old)[0])
-                if n != o
-            ] or ["pytree structure differs"]
+            diff = ([f"missing {k}" for k in old.keys() - new.keys()]
+                    + [f"unexpected {k}" for k in new.keys() - old.keys()]
+                    + [f"{k}: {new[k]} vs engine's {old[k]}"
+                       for k in old.keys() & new.keys()
+                       if old[k] != new[k]])
             raise ValueError(
                 "checkpoint structure mismatch: " + "; ".join(diff[:5]))
         self.variables = jax.device_put(variables)

@@ -1,0 +1,44 @@
+"""trace_summary's gviz parsing + report rollup, on a synthetic table
+shaped like xprof's hlo_stats output (the real conversion needs an
+on-accelerator XPlane capture; the parse/report layer is what must not
+break between captures)."""
+
+import raft_tpu.cli.trace_summary as ts
+
+
+GVIZ = {
+    "cols": [{"id": "category"}, {"id": "hlo_op_name"},
+             {"id": "occurrences"}, {"id": "total_self_time"},
+             {"id": "total_self_time_percent"}, {"id": "bound_by"},
+             {"id": "measured_memory_bw"}],
+    "rows": [
+        {"c": [{"v": "convolution"}, {"v": "conv.1"}, {"v": 24},
+               {"v": 1000.0}, {"v": 50.0}, {"v": "compute"}, {"v": 400.0}]},
+        {"c": [{"v": "fusion"}, {"v": "fusion.7"}, {"v": 12},
+               {"v": 600.0}, {"v": 30.0}, {"v": "memory"}, {"v": 120.0}]},
+        {"c": [{"v": "convolution"}, {"v": "conv.2"}, {"v": 24},
+               {"v": 400.0}, {"v": 20.0}, {"v": "compute"}, None]},
+    ],
+}
+
+
+def test_parse_gviz_rows():
+    rows = ts.parse_gviz(GVIZ)
+    assert len(rows) == 3
+    assert rows[0]["hlo_op_name"] == "conv.1"
+    assert rows[2]["measured_memory_bw"] is None  # tolerated by report
+
+
+def test_report_rollup_and_order(capsys):
+    ts.report(ts.parse_gviz(GVIZ), top=2)
+    out = capsys.readouterr().out
+    assert "total 2,000 us" in out
+    # convolution (1400) must lead the rollup, conv.1 the top table
+    roll, topn = out.split("== top 2 ops")
+    assert roll.index("convolution") < roll.index("fusion")
+    assert "conv.1" in topn and "conv.2" not in topn
+
+
+def test_report_empty(capsys):
+    ts.report([], top=5)
+    assert "no device op rows" in capsys.readouterr().out
