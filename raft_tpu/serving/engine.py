@@ -40,10 +40,28 @@ class RAFTEngine:
     def __init__(self, variables: Dict, config: RAFTConfig = RAFTConfig(),
                  iters: int = ITERS_EXPORT,
                  envelope: Sequence[Tuple[int, int, int]] = (),
-                 precompile: bool = True):
+                 precompile: bool = True, mesh=None):
+        """``mesh``: optional ``jax.sharding.Mesh`` (data × spatial axes,
+        `parallel.mesh.make_mesh`) — buckets then compile as SPMD
+        programs with batch sharded over 'data' and image height over
+        'spatial' (weights replicated), the serving-side counterpart of
+        the sharded train step for resolutions/batches beyond one chip
+        (SURVEY.md §5 long-context). The TRT analog has nothing like
+        this; DataParallel never served (train.py:138 is training-only).
+        """
         self.config = config
         self.iters = iters
-        self.variables = jax.device_put(variables)
+        self.mesh = mesh
+        if mesh is not None:
+            from raft_tpu.parallel.mesh import (batch_sharding, replicated,
+                                                validate_spatial_extent)
+
+            self._in_shard = batch_sharding(mesh)
+            self._rep = replicated(mesh)
+            self._validate_extent = validate_spatial_extent
+            self.variables = jax.device_put(variables, self._rep)
+        else:
+            self.variables = jax.device_put(variables)
         model = RAFT(config)
 
         def serve(variables, image1, image2):
@@ -77,6 +95,17 @@ class RAFTEngine:
         checkpoint into a small-config engine, or bf16-cast weights)
         would brick every precompiled bucket with an opaque call-time
         error if it slipped through here."""
+        old_def = jax.tree_util.tree_structure(self.variables)
+        new_def = jax.tree_util.tree_structure(variables)
+        if old_def != new_def:
+            # container types matter: the executables were lowered against
+            # the old treedef, and e.g. FrozenDict vs plain dict flattens
+            # to identical key paths while still failing at call time
+            raise ValueError(
+                "checkpoint structure mismatch: pytree definition differs "
+                f"(engine: {str(old_def)[:120]}... vs {str(new_def)[:120]}"
+                "...)")
+
         def avals(tree):
             return {jax.tree_util.keystr(k): (jnp.shape(l),
                                               jnp.result_type(l))
@@ -85,14 +114,13 @@ class RAFTEngine:
 
         old, new = avals(self.variables), avals(variables)
         if old != new:
-            diff = ([f"missing {k}" for k in old.keys() - new.keys()]
-                    + [f"unexpected {k}" for k in new.keys() - old.keys()]
-                    + [f"{k}: {new[k]} vs engine's {old[k]}"
-                       for k in old.keys() & new.keys()
-                       if old[k] != new[k]])
+            diff = [f"{k}: {new[k]} vs engine's {old[k]}"
+                    for k in old.keys() & new.keys() if old[k] != new[k]]
             raise ValueError(
                 "checkpoint structure mismatch: " + "; ".join(diff[:5]))
-        self.variables = jax.device_put(variables)
+        self.variables = (jax.device_put(variables, self._rep)
+                          if self.mesh is not None
+                          else jax.device_put(variables))
 
     # -- shape routing ------------------------------------------------------
 
@@ -100,7 +128,12 @@ class RAFTEngine:
         exe = self._compiled.get(shape)
         if exe is None:
             b, h, w = shape
-            spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+            if self.mesh is not None:
+                self._validate_extent(h, self.mesh)
+                spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32,
+                                            sharding=self._in_shard)
+            else:
+                spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
             exe = self._fn.lower(self.variables, spec, spec).compile()
             self._compiled[shape] = exe
         return exe
@@ -127,15 +160,31 @@ class RAFTEngine:
 
         bucket = self._select_bucket(b, hp, wp)
         if bucket is None:
-            bucket = (b, hp, wp)  # compile-on-miss, cached thereafter
+            bb, bh = b, hp
+            if self.mesh is not None:
+                # batch rides the 'data' axis, height the 'spatial' axis —
+                # round the ad-hoc bucket up so every device gets whole
+                # examples and whole feature rows (the bucket's zero-fill
+                # + output crop absorbs the padding either way)
+                data = self.mesh.shape.get("data", 1)
+                spatial = self.mesh.shape.get("spatial", 1)
+                bb = -(-b // data) * data
+                bh = -(-hp // (8 * spatial)) * (8 * spatial)
+            bucket = (bb, bh, wp)  # compile-on-miss, cached thereafter
         bb, bh, bw = bucket
         # edge-pad to stride alignment (InputPadder semantics), zero-fill the
         # rest of the bucket
         align = ((0, 0), (top, bottom), (left, right), (0, 0))
         fill = ((0, bb - b), (0, bh - hp), (0, bw - wp), (0, 0))
-        i1 = jnp.asarray(np.pad(np.pad(image1, align, mode="edge"), fill))
-        i2 = jnp.asarray(np.pad(np.pad(image2, align, mode="edge"), fill))
-        flow = self._get_executable(bucket)(self.variables, i1, i2)
+        i1 = np.pad(np.pad(image1, align, mode="edge"), fill)
+        i2 = np.pad(np.pad(image2, align, mode="edge"), fill)
+        exe = self._get_executable(bucket)  # validates extent under a mesh
+        if self.mesh is not None:
+            i1 = jax.device_put(i1, self._in_shard)
+            i2 = jax.device_put(i2, self._in_shard)
+        else:
+            i1, i2 = jnp.asarray(i1), jnp.asarray(i2)
+        flow = exe(self.variables, i1, i2)
         return np.asarray(flow[:b, top:top + h, left:left + w, :])
 
     def infer(self, images: Sequence[np.ndarray], batch_size: int = 4,
