@@ -10,6 +10,9 @@ while true; do
     if timeout 180 python -c "import jax; d = jax.devices(); assert d[0].platform != 'cpu', d" >/dev/null 2>&1; then
         echo "$(date -u +%H:%M:%S) chip up — launching round2b" >> "$LOG"
         bash /root/repo/tools/onchip_round2b.sh "$OUT"
+        # land the results in the repo so the round-end snapshot commit
+        # preserves them even if the session is over by then
+        cp "$OUT" /root/repo/ONCHIP_r02.log 2>/dev/null || true
         echo "$(date -u +%H:%M:%S) round2b done" >> "$LOG"
         exit 0
     fi
