@@ -121,6 +121,21 @@ class TestMeshServing:
         # (the r1 spatial miscompile was 43)
         np.testing.assert_allclose(got, ref, atol=0.05, rtol=1e-4)
 
+    def test_sharded_miss_rounds_height_to_spatial_axis(self, small_setup,
+                                                        rng):
+        """A 72-px image on a spatial=2 mesh has 9 feature rows — not
+        divisible by the axis — so the ad-hoc bucket must round height up
+        (to 80) rather than refuse, and crop the output back."""
+        from raft_tpu.parallel.mesh import make_mesh
+
+        cfg, variables = small_setup
+        eng = RAFTEngine(variables, cfg, iters=1, envelope=[],
+                         mesh=make_mesh(4, spatial=2))
+        img = rng.rand(1, 72, 64, 3).astype(np.float32) * 255
+        flow = eng.infer_batch(img, img)
+        assert flow.shape == (1, 72, 64, 2)
+        assert (2, 80, 64) in eng._compiled  # b->data axis, h->8*spatial
+
     def test_sharded_engine_rejects_thin_spatial_shards(self, small_setup,
                                                        rng):
         from raft_tpu.parallel.mesh import make_mesh
