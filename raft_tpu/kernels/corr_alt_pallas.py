@@ -76,6 +76,15 @@ def _wspan(P: int) -> int:
     return -(-(P + 7) // 8) * 8
 
 
+def _wextra(radius: int) -> int:
+    """Extra right-W zeros pad_f2_pyramid adds beyond the 2·PAD halo so the
+    widened `_wspan` DMA stays in-bounds. Every site that pads, unpads, or
+    recovers the true level width from a padded buffer MUST use this one
+    expression — the three are coupled."""
+    P = 2 * radius + 2
+    return _wspan(P) - P
+
+
 def _alt_kernel(base_ref, wy_ref, wx_ref, f1_ref, f2_ref, out_ref,
                 ring, sems, win_ref, *, Q: int, K: int):
     """One grid step: Q queries of one batch element.
@@ -148,8 +157,7 @@ def pad_f2_pyramid(f2_pyramid: Sequence[jax.Array], radius: int):
     Do this once per forward pass, outside the scanned refinement loop.
     """
     PAD = _pad(radius)
-    P = 2 * radius + 2
-    extra = _wspan(P) - P  # DMA-end bound: x0a + WSPAN <= Wl + 2*PAD + extra
+    extra = _wextra(radius)  # DMA-end bound: x0a + WSPAN <= Wl + 2*PAD + extra
     return tuple(
         jnp.pad(f2, ((0, 0), (PAD, PAD), (PAD, PAD + extra), (0, 0)))
         for f2 in f2_pyramid)
@@ -179,7 +187,13 @@ def _level_alt_pallas(f1: jax.Array, f2_p: jax.Array, x: jax.Array,
     _, Hp, Wp, _ = f2_p.shape
     K = 2 * radius + 1
     PAD = _pad(radius)
-    base, wy, wx = _prep_coords(Hp - 2 * PAD, Wp - 2 * PAD, x, y, radius)
+    # Wp carries pad_f2_pyramid's `_wextra` right-margin zeros on top of
+    # the 2·PAD halo; subtract BOTH to recover the true level width, else
+    # the x clamp admits coords whose 8-aligned window DMA (x0a + WSPAN)
+    # runs past the padded buffer — an OOB HBM read on real Mosaic DMAs
+    # (XLA interpret mode hides it by clamping dynamic_slice).
+    base, wy, wx = _prep_coords(
+        Hp - 2 * PAD, Wp - 2 * PAD - _wextra(radius), x, y, radius)
 
     n_pad = (-N) % _QTILE
     if n_pad:
@@ -243,8 +257,7 @@ def _alt_bwd(radius, res, g):
     fmap1, f2_pyramid_p, x, y = res
     B, N, C = fmap1.shape
     PAD = _pad(radius)
-    P = 2 * radius + 2
-    extra = _wspan(P) - P  # pad_f2_pyramid's extra right-W margin
+    extra = _wextra(radius)  # pad_f2_pyramid's extra right-W margin
 
     def xla_fwd(f1, f2s, xq, yq):
         # alt_corr_lookup takes (B,H,W,C) fmap1 and unpadded f2 pyramid +

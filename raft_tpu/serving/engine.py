@@ -124,12 +124,31 @@ class RAFTEngine:
 
     # -- shape routing ------------------------------------------------------
 
+    def _mesh_grain(self) -> Tuple[int, int]:
+        """(batch grain, height grain) a bucket must divide under a mesh.
+        Single source for both the compile-time check and the
+        compile-on-miss rounding — the two must agree or the router's own
+        ad-hoc buckets would fail the engine's validation."""
+        data = self.mesh.shape.get("data", 1)
+        spatial = self.mesh.shape.get("spatial", 1)
+        return data, 8 * spatial
+
     def _get_executable(self, shape: Tuple[int, int, int]):
         exe = self._compiled.get(shape)
         if exe is None:
             b, h, w = shape
             if self.mesh is not None:
                 self._validate_extent(h, self.mesh)
+                # compile-on-miss buckets are pre-rounded in infer_batch,
+                # but user-supplied envelope buckets reach here unrounded;
+                # an uneven bucket compiles fine and only fails later at
+                # device_put with an opaque uneven-sharding ValueError
+                bg, hg = self._mesh_grain()
+                if b % bg or h % hg:
+                    raise ValueError(
+                        f"bucket {shape} is not mesh-divisible: batch must "
+                        f"be a multiple of data={bg} and height a "
+                        f"multiple of 8*spatial={hg}")
                 spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32,
                                             sharding=self._in_shard)
             else:
@@ -166,10 +185,9 @@ class RAFTEngine:
                 # round the ad-hoc bucket up so every device gets whole
                 # examples and whole feature rows (the bucket's zero-fill
                 # + output crop absorbs the padding either way)
-                data = self.mesh.shape.get("data", 1)
-                spatial = self.mesh.shape.get("spatial", 1)
-                bb = -(-b // data) * data
-                bh = -(-hp // (8 * spatial)) * (8 * spatial)
+                bg, hg = self._mesh_grain()
+                bb = -(-b // bg) * bg
+                bh = -(-hp // hg) * hg
             bucket = (bb, bh, wp)  # compile-on-miss, cached thereafter
         bb, bh, bw = bucket
         # edge-pad to stride alignment (InputPadder semantics), zero-fill the

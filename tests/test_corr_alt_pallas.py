@@ -93,6 +93,43 @@ def test_coords_grad_matches_xla_alt(setup):
     np.testing.assert_allclose(g_got, g_want, atol=1e-4, rtol=1e-4)
 
 
+def test_window_dma_in_bounds_at_extreme_coords():
+    """The 8-aligned window DMA must stay inside the padded buffer for
+    EVERY reachable coordinate. Interpret mode hides violations (XLA
+    dynamic_slice clamps; Mosaic TPU DMAs do not), so pin the bound
+    structurally: derive the clamp exactly as _level_alt_pallas does and
+    check x0a + WSPAN <= Wp and y0 + P <= Hp for far-OOB queries.
+
+    Regression: pad_f2_pyramid adds `extra` right-margin zeros beyond the
+    2*PAD halo; deriving the level width as Wp - 2*PAD (without the
+    -extra) inflates the x clamp and lets the DMA end up to extra columns
+    past the buffer — an OOB HBM read on chip."""
+    for radius in (2, 3, 4):
+        P = 2 * radius + 2
+        PAD = corr_alt_pallas._pad(radius)
+        WSPAN = corr_alt_pallas._wspan(P)
+        extra = WSPAN - P
+        for Hl, Wl in [(8, 12), (46, 62), (5, 7)]:
+            f2 = jnp.zeros((1, Hl, Wl, 8), jnp.float32)
+            (f2_p,) = corr_alt_pallas.pad_f2_pyramid([f2], radius)
+            _, Hp, Wp, _ = f2_p.shape
+            # the exact width recovery _level_alt_pallas performs
+            assert Wp - 2 * PAD - extra == Wl
+            # worst-case coords: far past every edge
+            x = jnp.asarray([[-1e4, 1e4, Wl + 30.0]])
+            y = jnp.asarray([[-1e4, 1e4, Hl + 30.0]])
+            base, _, _ = corr_alt_pallas._prep_coords(
+                Hp - 2 * PAD, Wp - 2 * PAD - extra, x, y, radius)
+            x0a = np.asarray(base[..., 0])
+            y0 = np.asarray(base[..., 1])
+            off = np.asarray(base[..., 2])
+            assert (x0a >= 0).all() and (y0 >= 0).all()
+            assert (x0a % 8 == 0).all()
+            assert (off >= 0).all() and (off < 8).all()
+            assert (x0a + WSPAN <= Wp).all(), (x0a.max() + WSPAN, Wp)
+            assert (y0 + P <= Hp).all(), (y0.max() + P, Hp)
+
+
 def test_model_alternate_corr_pallas_matches_xla():
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models import RAFT

@@ -136,6 +136,28 @@ class TestMeshServing:
         assert flow.shape == (1, 72, 64, 2)
         assert (2, 80, 64) in eng._compiled  # b->data axis, h->8*spatial
 
+    def test_envelope_bucket_must_be_mesh_divisible(self, small_setup):
+        """A user-supplied envelope bucket whose batch doesn't divide the
+        'data' axis (or height the 8*spatial grain) would compile fine and
+        only explode later at device_put with an uneven-sharding error —
+        reject it at compile time with a readable message instead."""
+        from raft_tpu.parallel.mesh import make_mesh
+
+        cfg, variables = small_setup
+        mesh = make_mesh(4, spatial=2)
+        with pytest.raises(ValueError, match="not mesh-divisible"):
+            RAFTEngine(variables, cfg, iters=1, envelope=[(1, 64, 64)],
+                       mesh=mesh)
+        # h=68 passes validate_spatial_extent (68//8=8 rows, even over
+        # spatial=2) but is not a multiple of 8*spatial
+        with pytest.raises(ValueError, match="not mesh-divisible"):
+            RAFTEngine(variables, cfg, iters=1, envelope=[(2, 68, 64)],
+                       mesh=mesh)
+        # a divisible bucket still compiles
+        eng = RAFTEngine(variables, cfg, iters=1, envelope=[(2, 64, 64)],
+                         mesh=mesh)
+        assert (2, 64, 64) in eng._compiled
+
     def test_sharded_engine_rejects_thin_spatial_shards(self, small_setup,
                                                        rng):
         from raft_tpu.parallel.mesh import make_mesh
