@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
 import sys
 import time
@@ -128,26 +129,95 @@ def emit(metric, value):
     }), flush=True)
 
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--batches", type=int, nargs="+", default=[6, 4, 2])
-    p.add_argument("--remat", action="store_true")
-    p.add_argument("--remat-policy", default=None, choices=["full", "dots"],
+# JSON-supplied defaults are validated against these before use — a typo'd
+# BENCH_DEFAULTS.json must fail HERE with a log line, not deep inside a
+# multi-minute remote compile
+_DEFAULTS_SCHEMA = {
+    "batches": lambda v: (isinstance(v, list) and v
+                          and all(isinstance(b, int) and b > 0 for b in v)),
+    "remat": lambda v: isinstance(v, bool),
+    "remat_policy": lambda v: v in ("full", "dots"),
+    "corr_impl": lambda v: v in ("gather", "onehot", "pallas"),
+    "corr_dtype": lambda v: v in ("float32", "bfloat16"),
+}
+
+
+def _apply_measured_defaults(args, passed):
+    """Fold in ``BENCH_DEFAULTS.json`` (written by the on-chip config-ladder
+    runbook) so a bare ``python bench.py`` runs the best MEASURED config,
+    not a guess — the driver invokes bench with no flags. Flags the user
+    actually passed (``passed``, from the suppressed-defaults re-parse)
+    always win, including ``--no-remat`` and values that happen to equal
+    the parser default."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_DEFAULTS.json")
+    if not os.path.exists(path):
+        return
+    try:
+        with open(path) as f:
+            stored = json.load(f)
+    except (OSError, ValueError) as exc:
+        log(f"ignoring unreadable BENCH_DEFAULTS.json: {exc}")
+        return
+    applied = {}
+    for k, check in _DEFAULTS_SCHEMA.items():
+        if k not in stored or k in passed:
+            continue
+        if not check(stored[k]):
+            log(f"ignoring BENCH_DEFAULTS.json: bad {k}={stored[k]!r}")
+            return
+        applied[k] = stored[k]
+    for k, v in applied.items():
+        setattr(args, k, v)
+    if args.remat_policy and not args.remat and "remat_policy" not in passed:
+        # a JSON-sourced policy is meaningless once the user turned remat
+        # off (--no-remat); dropping it beats erroring on a flag the user
+        # never typed
+        args.remat_policy = None
+        applied.pop("remat_policy", None)
+    if applied:
+        log(f"BENCH_DEFAULTS.json applied: {applied}")
+
+
+def _build_parser(suppress=False):
+    """``suppress=True`` builds the twin parser whose namespace contains
+    ONLY flags the user actually typed — how _apply_measured_defaults
+    distinguishes 'left at default' from 'explicitly passed the default'."""
+    kw = dict(argument_default=argparse.SUPPRESS) if suppress else {}
+    p = argparse.ArgumentParser(**kw)
+
+    def default(v):
+        return argparse.SUPPRESS if suppress else v
+
+    p.add_argument("--batches", type=int, nargs="+", default=default([6, 4, 2]))
+    p.add_argument("--remat", action=argparse.BooleanOptionalAction,
+                   default=default(False))
+    p.add_argument("--remat-policy", default=default(None),
+                   choices=["full", "dots"],
                    help="remat granularity (with --remat); 'dots' saves "
                         "conv/GEMM outputs, recomputes elementwise")
-    p.add_argument("--warmup", type=int, default=2)
-    p.add_argument("--steps", type=int, default=20)
-    p.add_argument("--deadline-s", type=float, default=2400.0,
+    p.add_argument("--warmup", type=int, default=default(2))
+    p.add_argument("--steps", type=int, default=default(20))
+    p.add_argument("--deadline-s", type=float, default=default(2400.0),
                    help="no new attempt starts after this wall-clock budget")
-    p.add_argument("--corr-impl", default=None,
-                   help="override RAFTConfig.corr_impl (gather/onehot/pallas)")
-    p.add_argument("--corr-dtype", default=None,
+    p.add_argument("--corr-impl", default=default(None),
+                   choices=["gather", "onehot", "pallas"],
+                   help="override RAFTConfig.corr_impl")
+    p.add_argument("--corr-dtype", default=default(None),
+                   choices=["float32", "bfloat16"],
                    help="override RAFTConfig.corr_dtype (bfloat16 halves "
                         "volume traffic; fp32 is reference parity)")
-    p.add_argument("--hw", type=int, nargs=2, default=list(IMAGE_HW),
+    p.add_argument("--hw", type=int, nargs=2, default=default(list(IMAGE_HW)),
                    help="crop H W (divisible by 8); defaults to the "
                         "chairs-stage crop, e.g. 400 720 for things")
+    return p
+
+
+def main():
+    p = _build_parser()
     args = p.parse_args()
+    passed = vars(_build_parser(suppress=True).parse_args()).keys()
+    _apply_measured_defaults(args, passed)
     if args.remat_policy and not args.remat:
         p.error("--remat-policy requires --remat (without it the policy "
                 "is a silent no-op and the run measures a baseline step)")

@@ -1,0 +1,111 @@
+"""BENCH_DEFAULTS.json plumbing: the on-chip ladder picks the fastest
+measured config (tools/pick_bench_defaults.py) and a bare ``python
+bench.py`` must fold it in without overriding explicit flags."""
+
+import argparse
+import importlib.util
+import json
+import sys
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def modules():
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", "/root/repo/bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    saved = sys.argv
+    sys.argv = ["bench.py"]
+    try:
+        spec.loader.exec_module(bench)
+    finally:
+        sys.argv = saved
+    spec2 = importlib.util.spec_from_file_location(
+        "pick_mod", "/root/repo/tools/pick_bench_defaults.py")
+    pick = importlib.util.module_from_spec(spec2)
+    spec2.loader.exec_module(pick)
+    return bench, pick
+
+
+class TestFlagsFromMetric:
+    def test_parses_every_ladder_shape(self, modules):
+        _, pick = modules
+        f = pick.flags_from_metric
+        assert f("raft_basic_train_chairs_368x496_bf16_b8_iters12_1chip"
+                 ) == {"batches": [8]}
+        assert f("raft_basic_train_chairs_368x496_bf16_b8_iters12_1chip"
+                 "_corrbfloat16") == {"batches": [8],
+                                      "corr_dtype": "bfloat16"}
+        got = f("raft_basic_train_chairs_368x496_bf16_b10_iters12_1chip"
+                "_remat_dots_corrbfloat16")
+        assert got == {"batches": [10], "remat": True,
+                       "remat_policy": "dots", "corr_dtype": "bfloat16"}
+        assert f("raft_basic_train_368x496_failed") is None
+
+    def test_picker_prefers_highest_value(self, modules, tmp_path):
+        _, pick = modules
+        (tmp_path / "a.json").write_text(json.dumps(
+            {"metric": "raft_basic_train_chairs_368x496_bf16_b6_iters12"
+                       "_1chip", "value": 11.5}) + "\n")
+        (tmp_path / "b.json").write_text(json.dumps(
+            {"metric": "raft_basic_train_chairs_368x496_bf16_b8_iters12"
+                       "_1chip_corrbfloat16", "value": 21.0}) + "\n")
+        (tmp_path / "c.json").write_text(json.dumps(
+            {"metric": "raft_basic_train_chairs_368x496_failed",
+             "value": 0.0}) + "\n")
+        best = None
+        for name in sorted(p.name for p in tmp_path.glob("*.json")):
+            rec = json.loads((tmp_path / name).read_text())
+            if rec["value"] > 0 and (best is None
+                                     or rec["value"] > best["value"]):
+                best = rec
+        assert pick.flags_from_metric(best["metric"]) == {
+            "batches": [8], "corr_dtype": "bfloat16"}
+
+
+class TestApplyMeasuredDefaults:
+    def _merge(self, bench, argv):
+        args = bench._build_parser().parse_args(argv)
+        passed = vars(bench._build_parser(suppress=True)
+                      .parse_args(argv)).keys()
+        bench._apply_measured_defaults(args, passed)
+        return args
+
+    def test_defaults_applied_and_explicit_flags_win(self, modules,
+                                                     tmp_path, monkeypatch):
+        bench, _ = modules
+        defaults = {"batches": [8], "corr_dtype": "bfloat16", "remat": True,
+                    "remat_policy": "dots", "_measured": {"value": 21.0}}
+        (tmp_path / "BENCH_DEFAULTS.json").write_text(json.dumps(defaults))
+        monkeypatch.setattr(bench.os.path, "dirname",
+                            lambda _: str(tmp_path))
+        args = self._merge(bench, [])
+        assert args.batches == [8] and args.corr_dtype == "bfloat16"
+        assert args.remat is True and args.remat_policy == "dots"
+        assert not hasattr(args, "_measured")
+
+        args2 = self._merge(bench, ["--batches", "4", "2"])
+        assert args2.batches == [4, 2]          # explicit wins
+        assert args2.corr_dtype == "bfloat16"   # untouched default filled
+
+        # --no-remat must beat the JSON even though False == parser
+        # default, and the JSON's now-meaningless policy is dropped
+        # rather than tripping the --remat-policy-requires-remat error
+        args3 = self._merge(bench, ["--no-remat"])
+        assert args3.remat is False
+        assert args3.remat_policy is None
+
+    def test_unreadable_or_invalid_file_is_ignored(self, modules, tmp_path,
+                                                   monkeypatch):
+        bench, _ = modules
+        monkeypatch.setattr(bench.os.path, "dirname",
+                            lambda _: str(tmp_path))
+        (tmp_path / "BENCH_DEFAULTS.json").write_text("{not json")
+        assert self._merge(bench, []).batches == [6, 4, 2]
+        # schema violations (typo'd policy) reject the whole file: fail
+        # at the argparse layer, not deep inside a remote compile
+        (tmp_path / "BENCH_DEFAULTS.json").write_text(json.dumps(
+            {"batches": [8], "remat_policy": "dot"}))
+        args = self._merge(bench, [])
+        assert args.batches == [6, 4, 2] and args.remat_policy is None

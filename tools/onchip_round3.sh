@@ -1,0 +1,116 @@
+#!/bin/bash
+# Round-3 on-chip runbook. Ordered by value-per-minute for ~100-min chip
+# windows; every step is marker-guarded so a dropped tunnel mid-run
+# resumes where it left off on the next window (the persistent compile
+# cache makes re-entry cheap).
+#
+# Produces, inside the repo (for the round-end snapshot):
+#   ONCHIP_r03.log           — raw session log (VERDICT r2 missing #2)
+#   BENCH_DEFAULTS.json      — best MEASURED bench config (bench.py reads it)
+#   runs/metrics.jsonl       — 500-step training loss series (missing #3)
+set -u
+cd /root/repo
+OUT=${1:-/tmp/onchip_round3.out}
+MARK=/root/.cache/raft_tpu/r3_markers
+LADDER=/root/.cache/raft_tpu/r3_ladder
+mkdir -p "$MARK" "$LADDER"
+log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
+step() {  # step <name> <timeout-s> <cmd...>
+    local name=$1 tmo=$2; shift 2
+    if [ -e "$MARK/$name" ]; then log "skip $name (done)"; return 0; fi
+    log "begin $name"
+    if timeout "$tmo" "$@" >> "$OUT" 2>&1; then
+        touch "$MARK/$name"; log "done $name"
+    else
+        log "FAILED rc=$? $name"
+    fi
+    cp "$OUT" /root/repo/ONCHIP_r03.log 2>/dev/null || true
+}
+bench_cfg() {  # bench_cfg <tag> <timeout> <flags...>
+    local tag=$1 tmo=$2; shift 2
+    if [ -e "$MARK/bench_$tag" ]; then log "skip bench_$tag"; return 0; fi
+    log "begin bench_$tag: $*"
+    if timeout "$tmo" python bench.py --steps 10 "$@" \
+            > "$LADDER/$tag.json" 2>> "$OUT"; then
+        cat "$LADDER/$tag.json" >> "$OUT"
+        touch "$MARK/bench_$tag"; log "done bench_$tag"
+    else
+        log "FAILED bench_$tag rc=$?"; cat "$LADDER/$tag.json" >> "$OUT"
+    fi
+    cp "$OUT" /root/repo/ONCHIP_r03.log 2>/dev/null || true
+}
+
+# ---- 1. headline config ladder (VERDICT r2 next-round #1) --------------
+bench_cfg a_fp32_b8      1800 --batches 8 6
+bench_cfg b_bf16_b8      1800 --batches 8 6 --corr-dtype bfloat16
+bench_cfg c_bf16_dots    1800 --batches 12 10 8 --corr-dtype bfloat16 \
+                              --remat --remat-policy dots
+bench_cfg d_fp32_dots    1800 --batches 12 10 8 --remat --remat-policy dots
+
+step pick_defaults 120 python tools/pick_bench_defaults.py "$LADDER"
+
+# ---- 2. 500-step training w/ real pipeline + save/resume (#5) ----------
+step train450 2400 python -m raft_tpu.cli.train --name r3synth \
+    --stage chairs --mixed_precision --synthetic 64 --num_steps 450 \
+    --val_freq 200 --batch_size 6 --num_workers 4 \
+    --checkpoint_dir /root/.cache/raft_tpu/r3_ck --log_dir runs
+step train500_resume 1800 python -m raft_tpu.cli.train --name r3synth \
+    --stage chairs --mixed_precision --synthetic 64 --num_steps 500 \
+    --val_freq 200 --batch_size 6 --num_workers 4 --resume \
+    --checkpoint_dir /root/.cache/raft_tpu/r3_ck --log_dir runs
+
+# ---- 3. trace: attribute the unexplained ~300 ms (PROFILE.md) ----------
+# mirror the ladder's winning config so the trace explains the headline
+step trace 2400 python - <<'PYEOF'
+import json, os, sys
+from raft_tpu.cli import profile_step
+argv = ["--steps", "10", "--trace-dir", "/tmp/raft_trace_r3"]
+try:
+    with open("/root/repo/BENCH_DEFAULTS.json") as f:
+        d = json.load(f)
+    argv += ["--batch", str(d.get("batches", [6])[0])]
+    if d.get("corr_dtype"):
+        argv += ["--corr_dtype", d["corr_dtype"]]
+    if d.get("remat"):
+        argv += ["--remat"]
+except OSError:
+    argv += ["--batch", "6"]
+print("profile_step", argv, flush=True)
+sys.exit(profile_step.main(argv))
+PYEOF
+step trace_summary 1200 python -m raft_tpu.cli.trace_summary \
+    /tmp/raft_trace_r3 --top 30
+
+# ---- 4. kernel shootout completion (VERDICT #3: pallas + alt_pallas) ---
+step corr_fwd 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls onehot pallas
+step corr_grad 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls onehot pallas --grad
+step corr_alt 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls alt alt_pallas
+step corr_alt_128 2400 python -m raft_tpu.cli.corr_bench --batch 1 \
+    --hw 128 128 --iters 10 --impls alt alt_pallas
+
+# ---- 5. serving at the envelope + export cycle (VERDICT #7) ------------
+step infer_fp32 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024
+step infer_bf16 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024 \
+    --corr_dtype bfloat16
+step export_cycle 2400 python tools/export_cycle_check.py
+
+# ---- 6. trained-weights parity + bf16-volume delta (VERDICT #2/#4) -----
+# cheap (two forwards per model); runs only once the CPU-trained genuine
+# .pth exists (tools/train_reference_ckpt.py)
+if [ -f /root/.cache/raft_tpu/ref_ckpt/raft-basic-cputrained.pth ]; then
+    step trained_parity 2400 python tools/trained_parity.py
+fi
+
+log "runbook complete"
+cp "$OUT" /root/repo/ONCHIP_r03.log 2>/dev/null || true
+# artifacts-only commit so a round-end snapshot can't lose the evidence
+cp /root/.cache/raft_tpu/ref_ckpt/trained_parity.json \
+    /root/repo/TRAINED_PARITY_onchip.json 2>/dev/null || true
+cd /root/repo && git add -A ONCHIP_r03.log BENCH_DEFAULTS.json \
+    runs/metrics.jsonl TRAINED_PARITY_onchip.json 2>/dev/null
+git diff --cached --quiet || git commit -q -m \
+    "On-chip round-3 artifacts: bench ladder, training run, kernel shootout" \
+    -m "No-Verification-Needed: measurement logs and recorded defaults only"

@@ -1,0 +1,75 @@
+"""Pick the fastest measured bench config and pin it as the default.
+
+Reads the per-config JSON lines the round-3 ladder wrote (one file per
+config under the dir given as argv[1]), takes the argmax by value, and
+writes ``BENCH_DEFAULTS.json`` at the repo root — which ``bench.py`` folds
+into its defaults so the driver's bare ``python bench.py`` reruns the
+proven-best configuration instead of a guess.
+
+The flag reconstruction parses the metric NAME (bench.py's ``emit`` tags
+encode batch/remat/corr choices), so this stays correct if the ladder adds
+configs.
+"""
+
+import json
+import os
+import re
+import sys
+
+
+def flags_from_metric(metric: str):
+    m = re.search(r"_b(\d+)_iters", metric)
+    if not m:
+        return None
+    flags = {"batches": [int(m.group(1))]}
+    if "_remat" in metric:
+        flags["remat"] = True
+        if "_dots" in metric:
+            flags["remat_policy"] = "dots"
+    mc = re.search(r"_corr(bfloat16|float32)", metric)
+    if mc:
+        flags["corr_dtype"] = mc.group(1)
+    mi = re.search(r"_(gather|onehot|pallas)$", metric.replace(
+        "_corrbfloat16", "").replace("_corrfloat32", ""))
+    if mi:
+        flags["corr_impl"] = mi.group(1)
+    return flags
+
+
+def main():
+    ladder_dir = sys.argv[1]
+    best = None
+    for name in sorted(os.listdir(ladder_dir)):
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(ladder_dir, name)
+        try:
+            with open(path) as f:
+                lines = [ln for ln in f if ln.strip().startswith("{")]
+            rec = json.loads(lines[-1])
+        except (OSError, ValueError, IndexError):
+            continue
+        if rec.get("value", 0) <= 0:
+            continue
+        if best is None or rec["value"] > best[0]["value"]:
+            best = (rec, name)
+    if best is None:
+        print("no successful ladder run; BENCH_DEFAULTS.json not written")
+        return 1
+    rec, name = best
+    flags = flags_from_metric(rec["metric"])
+    if flags is None:
+        print(f"could not parse flags from metric {rec['metric']!r}")
+        return 1
+    out = dict(flags)
+    out["_measured"] = {"metric": rec["metric"], "value": rec["value"],
+                        "ladder_file": name}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "BENCH_DEFAULTS.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"BENCH_DEFAULTS.json <- {name}: {rec['value']} pairs/s {flags}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
