@@ -170,7 +170,15 @@ class RAFTEngine:
     def infer_batch(self, image1, image2) -> np.ndarray:
         """(B,H,W,3) float [0,255] -> (B,H,W,2) flow. Routes to a bucket,
         padding up (raft_trt_utils.pad_images analog); falls back to an
-        exact-shape jit specialization outside the envelope."""
+        exact-shape jit specialization outside the envelope.
+
+        Accuracy note: bucket fill beyond the ÷8 pad shifts the encoders'
+        instance-norm statistics, which couple every output pixel to the
+        fill content — measured a few px of pointwise movement with a
+        metric-neutral (<1e-2 px EPE) aggregate at trained weights
+        (tests/test_evaluation.py bucketing-delta test). TensorRT's
+        dynamic shapes don't pay this; exact-shape compile (an envelope
+        bucket per deployed shape) avoids it here."""
         image1 = np.asarray(image1, np.float32)
         image2 = np.asarray(image2, np.float32)
         b, h, w, _ = image1.shape

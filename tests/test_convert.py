@@ -6,6 +6,8 @@ OIHW→HWIO convention and the explicit-padding semantics without needing a
 reference checkpoint (none is downloadable offline).
 """
 
+import os.path as osp
+
 import numpy as np
 import pytest
 import torch
@@ -228,3 +230,68 @@ class TestDownloadModels:
         from raft_tpu.tools.download_models import main
 
         assert main(["--models-dir", str(tmp_path)]) == 1
+
+
+class TestGenuineTrainedArtifact:
+    """The bundled fixture pair is a REAL torch-saved checkpoint (CPU
+    training of the actual reference, tools/train_reference_ckpt.py) and
+    its conversion — the converter is pinned against a genuine artifact
+    with moved weights and accumulated BN statistics, not just
+    synth_state_dict shapes."""
+
+    FIX = osp.join(osp.dirname(__file__), "fixtures")
+
+    def test_pth_converts_and_matches_committed_msgpack(self):
+        import jax
+
+        from raft_tpu.tools.convert import load_converted, load_pth
+
+        pth = osp.join(self.FIX, "raft-small-cputrained.pth")
+        msg = osp.join(self.FIX, "raft-small-cputrained.msgpack")
+        if not (osp.exists(pth) and osp.exists(msg)):
+            pytest.skip("trained fixtures not present")
+        cfg = RAFTConfig(small=True)
+        got = load_pth(pth, cfg)
+        want = load_converted(msg, cfg)
+        leaves_g = jax.tree_util.tree_leaves_with_path(got)
+        leaves_w = dict(
+            (jax.tree_util.keystr(k), l)
+            for k, l in jax.tree_util.tree_leaves_with_path(want))
+        assert len(leaves_g) == len(leaves_w)
+        moved = 0.0
+        for k, l in leaves_g:
+            key = jax.tree_util.keystr(k)
+            np.testing.assert_array_equal(np.asarray(l), leaves_w[key],
+                                          err_msg=key)
+            moved = max(moved, float(np.abs(np.asarray(l)).max()))
+        assert moved > 0.1  # genuinely trained weights, not zeros
+
+    def test_trained_weights_produce_sane_flow(self):
+        import jax
+        import jax.numpy as jnp
+
+        from raft_tpu.models import RAFT
+        from raft_tpu.tools.convert import load_converted
+
+        msg = osp.join(self.FIX, "raft-small-cputrained.msgpack")
+        if not osp.exists(msg):
+            pytest.skip("trained fixture not present")
+        cfg = RAFTConfig(small=True)
+        variables = load_converted(msg, cfg)
+        from PIL import Image
+
+        src = osp.join(osp.dirname(__file__), "..", "demo-frames")
+        f1 = np.asarray(Image.open(
+            osp.join(src, "frame_0016.png")))[:128, :192].astype(np.float32)
+        f2 = np.asarray(Image.open(
+            osp.join(src, "frame_0017.png")))[:128, :192].astype(np.float32)
+        _, flow = RAFT(cfg).apply(variables, jnp.asarray(f1[None]),
+                                  jnp.asarray(f2[None]), iters=8,
+                                  test_mode=True)
+        flow = np.asarray(flow)[0]
+        assert np.isfinite(flow).all()
+        # trained weights keep flow in a physical range on real frames —
+        # random init emits O(100 px) garbage here (measured, see
+        # test_evaluation bucketing-delta docstring)
+        assert np.abs(flow).max() < 40.0, np.abs(flow).max()
+        assert np.abs(flow).mean() > 0.05

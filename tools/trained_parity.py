@@ -24,6 +24,9 @@ import sys
 import numpy as np
 
 REF = "/root/reference"
+# runnable as `python tools/trained_parity.py` — put the repo root on the
+# path so raft_tpu imports without an install step
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
 
 
 def torch_flow(pth, img1, img2, small, iters):
