@@ -97,6 +97,10 @@ step infer_bf16 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024 \
     --corr_dtype bfloat16
 step export_cycle 2400 python tools/export_cycle_check.py
 
+# ---- 5b. things-stage geometry (optional breadth: 400x720 crop) --------
+bench_cfg e_things_bf16  1800 --hw 400 720 --batches 6 4 \
+                              --corr-dtype bfloat16
+
 # ---- 6. trained-weights parity + bf16-volume delta (VERDICT #2/#4) -----
 # cheap (two forwards per model); runs only once the CPU-trained genuine
 # .pth exists (tools/train_reference_ckpt.py)

@@ -51,6 +51,9 @@ def main():
             continue
         if rec.get("value", 0) <= 0:
             continue
+        if "chairs_" not in rec.get("metric", ""):
+            continue  # informational geometries (e.g. things 400x720)
+            # must not set the chairs-crop headline defaults
         if best is None or rec["value"] > best[0]["value"]:
             best = (rec, name)
     if best is None:

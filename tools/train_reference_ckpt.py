@@ -96,6 +96,12 @@ def train_one(small, args, rng):
                                alternate_corr=False, dropout=0.0)
     torch.manual_seed(1234)
     model = TorchRAFT(targs)
+    resume_path = os.path.join(args.out, f"raft-{name}-cputrained.pth")
+    if args.resume and os.path.exists(resume_path):
+        sd = torch.load(resume_path, map_location="cpu")
+        model.load_state_dict({k.removeprefix("module."): v
+                               for k, v in sd.items()})
+        print(f"[{name}] resumed from {resume_path}", flush=True)
     model.train()  # BN stats accumulate (chairs stage leaves BN unfrozen,
     #                train.py:148 only freezes for later stages)
     opt = torch.optim.AdamW(model.parameters(), lr=args.lr,
@@ -103,7 +109,7 @@ def train_one(small, args, rng):
     pairs = make_pairs(args.pairs, tuple(args.hw), rng)
     log_path = os.path.join(args.out, f"train_log_{name}.jsonl")
     t0 = time.time()
-    with open(log_path, "w") as logf:
+    with open(log_path, "a" if args.resume else "w") as logf:
         for step in range(args.steps):
             batch = [pairs[rng.randint(len(pairs))]
                      for _ in range(args.batch)]
@@ -127,12 +133,22 @@ def train_one(small, args, rng):
             if step % 10 == 0:
                 print(f"[{name}] step {step} loss {rec['loss']:.3f} "
                       f"epe {rec['epe']:.2f} ({rec['t']}s)", flush=True)
+            if step and step % 200 == 0:
+                _save(model, args.out, name)  # survive an arbitrary kill
+
+    return _save(model, args.out, name)
+
+
+def _save(model, out, name):
+    import torch
 
     # the reference saves through nn.DataParallel, so consumers expect
-    # module.-prefixed keys (train.py:187, demo.py:27)
+    # module.-prefixed keys (train.py:187, demo.py:27); atomic rename so a
+    # kill mid-write can't corrupt the only copy
     sd = {f"module.{k}": v for k, v in model.state_dict().items()}
-    path = os.path.join(args.out, f"raft-{name}-cputrained.pth")
-    torch.save(sd, path)
+    path = os.path.join(out, f"raft-{name}-cputrained.pth")
+    torch.save(sd, path + ".tmp")
+    os.replace(path + ".tmp", path)
     print(f"saved {path}", flush=True)
     return path
 
@@ -152,6 +168,8 @@ def main():
     p.add_argument("--pairs", type=int, default=48)
     p.add_argument("--lr", type=float, default=2e-4)
     p.add_argument("--small", action="store_true", help="also train small")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from the existing cputrained .pth")
     args = p.parse_args()
     os.makedirs(args.out, exist_ok=True)
     rng = np.random.RandomState(0)
