@@ -41,8 +41,14 @@ bench_cfg() {  # bench_cfg <tag> <timeout> <flags...>
 }
 
 # ---- 1. headline config ladder (VERDICT r2 next-round #1) --------------
-bench_cfg a_fp32_b8      1800 --batches 8 6
+# most-likely winner first: if the window is short, the headline shot
+# (bf16 volumes cleared by the trained-weights EPE gate, batch 8) still
+# lands. fp32 next for the apples-to-apples delta, then remat variants.
 bench_cfg b_bf16_b8      1800 --batches 8 6 --corr-dtype bfloat16
+# write defaults immediately after the first result: if the tunnel dies
+# mid-ladder, the driver's bare bench.py still reruns a measured config
+step pick_defaults_early 120 python tools/pick_bench_defaults.py "$LADDER"
+bench_cfg a_fp32_b8      1800 --batches 8 6
 bench_cfg c_bf16_dots    1800 --batches 12 10 8 --corr-dtype bfloat16 \
                               --remat --remat-policy dots
 bench_cfg d_fp32_dots    1800 --batches 12 10 8 --remat --remat-policy dots
