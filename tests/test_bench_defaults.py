@@ -102,10 +102,10 @@ class TestApplyMeasuredDefaults:
         monkeypatch.setattr(bench.os.path, "dirname",
                             lambda _: str(tmp_path))
         (tmp_path / "BENCH_DEFAULTS.json").write_text("{not json")
-        assert self._merge(bench, []).batches == [6, 4, 2]
+        assert self._merge(bench, []).batches == [8, 6, 4, 2]
         # schema violations (typo'd policy) reject the whole file: fail
         # at the argparse layer, not deep inside a remote compile
         (tmp_path / "BENCH_DEFAULTS.json").write_text(json.dumps(
             {"batches": [8], "remat_policy": "dot"}))
         args = self._merge(bench, [])
-        assert args.batches == [6, 4, 2] and args.remat_policy is None
+        assert args.batches == [8, 6, 4, 2] and args.remat_policy is None

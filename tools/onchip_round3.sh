@@ -44,14 +44,17 @@ bench_cfg() {  # bench_cfg <tag> <timeout> <flags...>
 # most-likely winner first: if the window is short, the headline shot
 # (bf16 volumes cleared by the trained-weights EPE gate, batch 8) still
 # lands. fp32 next for the apples-to-apples delta, then remat variants.
-bench_cfg b_bf16_b8      1800 --batches 8 6 --corr-dtype bfloat16
+# every ladder row pins corr-dtype and remat EXPLICITLY so the
+# BENCH_DEFAULTS.json written mid-ladder can't bleed into later rows
+bench_cfg b_bf16_b8      1800 --batches 8 6 --corr-dtype bfloat16 --no-remat
 # write defaults immediately after the first result: if the tunnel dies
 # mid-ladder, the driver's bare bench.py still reruns a measured config
 step pick_defaults_early 120 python tools/pick_bench_defaults.py "$LADDER"
-bench_cfg a_fp32_b8      1800 --batches 8 6
+bench_cfg a_fp32_b8      1800 --batches 8 6 --corr-dtype float32 --no-remat
 bench_cfg c_bf16_dots    1800 --batches 12 10 8 --corr-dtype bfloat16 \
                               --remat --remat-policy dots
-bench_cfg d_fp32_dots    1800 --batches 12 10 8 --remat --remat-policy dots
+bench_cfg d_fp32_dots    1800 --batches 12 10 8 --corr-dtype float32 \
+                              --remat --remat-policy dots
 
 step pick_defaults 120 python tools/pick_bench_defaults.py "$LADDER"
 
@@ -105,7 +108,7 @@ step export_cycle 2400 python tools/export_cycle_check.py
 
 # ---- 5b. things-stage geometry (optional breadth: 400x720 crop) --------
 bench_cfg e_things_bf16  1800 --hw 400 720 --batches 6 4 \
-                              --corr-dtype bfloat16
+                              --corr-dtype bfloat16 --no-remat
 
 # ---- 6. trained-weights parity + bf16-volume delta (VERDICT #2/#4) -----
 # cheap (two forwards per model); runs only once the CPU-trained genuine
