@@ -40,7 +40,8 @@ class RAFTEngine:
     def __init__(self, variables: Dict, config: RAFTConfig = RAFTConfig(),
                  iters: int = ITERS_EXPORT,
                  envelope: Sequence[Tuple[int, int, int]] = (),
-                 precompile: bool = True, mesh=None):
+                 precompile: bool = True, mesh=None,
+                 exact_shapes: bool = False):
         """``mesh``: optional ``jax.sharding.Mesh`` (data × spatial axes,
         `parallel.mesh.make_mesh`) — buckets then compile as SPMD
         programs with batch sharded over 'data' and image height over
@@ -48,10 +49,18 @@ class RAFTEngine:
         the sharded train step for resolutions/batches beyond one chip
         (SURVEY.md §5 long-context). The TRT analog has nothing like
         this; DataParallel never served (train.py:138 is training-only).
+
+        ``exact_shapes``: never route to a larger bucket — compile (and
+        cache) one executable per exact ÷8-padded request shape instead.
+        Costs a compile per distinct shape but removes the bucket-fill
+        accuracy artifact entirely (the fill shifts instance-norm
+        statistics; see infer_batch) — the TRT-dynamic-shapes parity
+        setting for accuracy-sensitive serving.
         """
         self.config = config
         self.iters = iters
         self.mesh = mesh
+        self.exact_shapes = exact_shapes
         if mesh is not None:
             from raft_tpu.parallel.mesh import (batch_sharding, replicated,
                                                 validate_spatial_extent)
@@ -185,7 +194,7 @@ class RAFTEngine:
         left, right, top, bottom = pad_amounts(h, w)
         hp, wp = h + top + bottom, w + left + right
 
-        bucket = self._select_bucket(b, hp, wp)
+        bucket = None if self.exact_shapes else self._select_bucket(b, hp, wp)
         if bucket is None:
             bb, bh = b, hp
             if self.mesh is not None:

@@ -87,6 +87,40 @@ class TestEngine:
         with pytest.raises(ValueError, match="pytree definition"):
             eng.update_weights(freeze(variables))
 
+    def test_exact_shapes_mode_matches_plain_jit_bitwise(self, small_setup,
+                                                         rng):
+        """exact_shapes=True must never pad beyond the ÷8 rule, so its
+        output is BIT-identical to the plain jitted model — the accuracy
+        knob for the measured bucket-fill instance-norm artifact (a
+        bucketed engine only matches approximately)."""
+        import jax
+
+        from raft_tpu.models import RAFT
+
+        cfg, variables = small_setup
+        # 52x60: not a bucket shape; a bucketed engine would route it up
+        # to the 64x64 envelope bucket and fill
+        img1 = rng.rand(1, 52, 60, 3).astype(np.float32) * 255
+        img2 = rng.rand(1, 52, 60, 3).astype(np.float32) * 255
+
+        eng = RAFTEngine(variables, cfg, iters=2, envelope=[(1, 64, 64)],
+                         exact_shapes=True)
+        got = eng.infer_batch(img1, img2)
+        assert (1, 56, 64) in eng._compiled  # ÷8 pad only, no bucket
+        assert (1, 64, 64) in eng._compiled  # envelope still precompiled
+
+        from raft_tpu.ops.padding import InputPadder
+
+        model = RAFT(cfg)
+        i1 = jnp.asarray(img1)
+        i2 = jnp.asarray(img2)
+        padder = InputPadder(i1.shape)
+        p1, p2 = padder.pad(i1, i2)
+        _, flow = jax.jit(lambda v, a, b: model.apply(
+            v, a, b, iters=2, test_mode=True))(variables, p1, p2)
+        want = np.asarray(padder.unpad(flow))
+        np.testing.assert_array_equal(got, want)
+
     def test_sliding_window_sequence(self, small_setup, rng):
         cfg, variables = small_setup
         eng = RAFTEngine(variables, cfg, iters=2, envelope=[(2, 64, 64)])
