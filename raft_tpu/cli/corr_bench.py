@@ -58,6 +58,12 @@ def main(argv=None):
     p.add_argument("--grad", action="store_true",
                    help="bench value+grad (the train-step cost) instead of "
                         "forward only")
+    p.add_argument("--corr_dtype", "--corr-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="volume storage dtype for the materialized-pyramid "
+                        "impls (gather/onehot/pallas) — isolates the "
+                        "halved-traffic bf16 lever at lookup granularity; "
+                        "alt paths sample fmaps directly and are unaffected")
     args = p.parse_args(argv)
 
     from raft_tpu.kernels import (alt_corr_lookup_pallas, corr_lookup_pallas,
@@ -77,6 +83,9 @@ def main(argv=None):
 
     pyramid = jax.block_until_ready(
         tuple(build_corr_pyramid(fmap1, fmap2, args.levels)))
+    if args.corr_dtype != "float32":
+        pyramid = jax.block_until_ready(tuple(
+            v.astype(args.corr_dtype) for v in pyramid))
     # the model pads once OUTSIDE the refinement loop (raft.py wires
     # prepadded=True); bench the same configuration
     pyramid_pp = jax.block_until_ready(

@@ -95,6 +95,8 @@ step corr_fwd 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
     --iters 20 --impls onehot pallas
 step corr_grad 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
     --iters 20 --impls onehot pallas --grad
+step corr_bf16 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls gather onehot pallas --grad --corr-dtype bfloat16
 step corr_alt 2400 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
     --iters 20 --impls alt alt_pallas
 step corr_alt_128 2400 python -m raft_tpu.cli.corr_bench --batch 1 \
