@@ -1,0 +1,169 @@
+"""Training-dynamics parity: identical init + batches, torch vs raft_tpu.
+
+BASELINE config 4's acceptance is a FlyingChairs loss-curve match; real
+FlyingChairs can't be staged (zero egress), so this is the substitute at
+the same level of rigor as PARITY.md's trained-weights check: start BOTH
+implementations from the SAME weights (torch init -> tools/convert), feed
+them the SAME batch sequence (warped real Sintel frames), with the
+reference's exact training recipe — AdamW(lr, wdecay, eps) + OneCycleLR
+(num_steps+100, pct_start 0.05, linear anneal) (train.py:79-86), global
+norm clip 1.0 (train.py:196), gamma-weighted masked sequence loss
+(train.py:47-72) — and record both loss trajectories.
+
+What forward parity can't see, this does: train-mode BatchNorm batch
+statistics, the optimizer's step/bias-correction off-by-ones, the
+schedule's warmup shape, the loss mask arithmetic, and gradient flow
+through the scanned (vs unrolled) refinement loop. fp32 both sides;
+per-step divergence beyond float-noise growth indicates a semantic
+mismatch, not rounding.
+
+Writes train_dynamics.json: per-step (loss_torch, loss_jax) + summary.
+"""
+
+import argparse
+import json
+import os
+import os.path as osp
+import sys
+
+import numpy as np
+
+REF = "/root/reference"
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+sys.path.insert(0, osp.dirname(osp.abspath(__file__)))  # train_reference_ckpt
+sys.path.insert(0, osp.join(REF, "core"))
+
+
+def torch_run(batches, hw, steps, iters, lr, wdecay, eps, seed):
+    import torch
+
+    from raft import RAFT as TorchRAFT
+
+    targs = argparse.Namespace(small=False, mixed_precision=False,
+                               alternate_corr=False, dropout=0.0)
+    torch.manual_seed(seed)
+    model = TorchRAFT(targs)
+    sd0 = {f"module.{k}": v.clone() for k, v in model.state_dict().items()}
+    model.train()
+    opt = torch.optim.AdamW(model.parameters(), lr=lr, weight_decay=wdecay,
+                            eps=eps)
+    sched = torch.optim.lr_scheduler.OneCycleLR(
+        opt, lr, steps + 100, pct_start=0.05, cycle_momentum=False,
+        anneal_strategy="linear")
+    losses = []
+    for i1, i2, gt, valid in batches:
+        t1 = torch.from_numpy(i1).permute(0, 3, 1, 2)
+        t2 = torch.from_numpy(i2).permute(0, 3, 1, 2)
+        tgt = torch.from_numpy(gt).permute(0, 3, 1, 2)
+        tv = torch.from_numpy(valid)
+        preds = model(t1, t2, iters=iters)
+        # the reference's sequence_loss (train.py:47-72), verbatim math
+        mag = torch.sum(tgt ** 2, dim=1).sqrt()
+        vmask = (tv >= 0.5) & (mag < 400.0)
+        loss = 0.0
+        for j, pred in enumerate(preds):
+            w = 0.8 ** (len(preds) - j - 1)
+            i_loss = (pred - tgt).abs()
+            loss = loss + w * (vmask[:, None] * i_loss).mean()
+        opt.zero_grad()
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+        opt.step()
+        sched.step()
+        losses.append(float(loss.item()))
+    return sd0, losses
+
+
+def jax_run(sd0, batches, hw, steps, iters, lr, wdecay, eps):
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.tools.convert import convert_state_dict
+    from raft_tpu.models import RAFT
+    from raft_tpu.training.train_step import (create_train_state,
+                                              make_train_step)
+
+    model_cfg = RAFTConfig(small=False, mixed_precision=False)
+    train_cfg = TrainConfig(stage="chairs", num_steps=steps, batch_size=
+                            batches[0][0].shape[0], iters=iters, lr=lr,
+                            wdecay=wdecay, epsilon=eps, add_noise=False)
+    rng = jax.random.PRNGKey(0)
+    model = RAFT(model_cfg)
+    img = jnp.zeros((1, *hw, 3))
+    template = model.init(rng, img, img, iters=1)
+    variables = convert_state_dict(
+        {k: np.asarray(v) for k, v in sd0.items()}, template)
+    state = create_train_state(model_cfg, train_cfg, rng, image_hw=hw,
+                               init_variables=variables)
+    step_fn = jax.jit(make_train_step(model_cfg, train_cfg),
+                      donate_argnums=(0,))
+    losses = []
+    for i1, i2, gt, valid in batches:
+        batch = {"image1": jnp.asarray(i1), "image2": jnp.asarray(i2),
+                 "flow": jnp.asarray(gt), "valid": jnp.asarray(valid)}
+        state, metrics = step_fn(state, batch, rng)
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--iters", type=int, default=6)
+    p.add_argument("--hw", type=int, nargs=2, default=[184, 248])
+    p.add_argument("--lr", type=float, default=4e-4)      # chairs recipe
+    p.add_argument("--wdecay", type=float, default=1e-4)  # train_standard.sh
+    p.add_argument("--eps", type=float, default=1e-8)
+    p.add_argument("--out",
+                   default="/root/.cache/raft_tpu/train_dynamics.json")
+    args = p.parse_args()
+
+    from raft_tpu.utils.platform import setup_cli
+
+    setup_cli()
+    from train_reference_ckpt import make_pairs  # same data generator
+
+    rng = np.random.RandomState(7)
+    pairs = make_pairs(24, tuple(args.hw), rng)
+    batches = []
+    for _ in range(args.steps):
+        sel = [pairs[rng.randint(len(pairs))] for _ in range(args.batch)]
+        i1 = np.stack([s[0] for s in sel])
+        i2 = np.stack([s[1] for s in sel])
+        gt = np.stack([s[2] for s in sel])
+        valid = np.ones(gt.shape[:-1], np.float32)
+        batches.append((i1, i2, gt, valid))
+
+    sd0, loss_t = torch_run(batches, tuple(args.hw), args.steps, args.iters,
+                            args.lr, args.wdecay, args.eps, seed=1234)
+    print("torch done:", [round(v, 4) for v in loss_t[:5]], "...",
+          round(loss_t[-1], 4), flush=True)
+    loss_j = jax_run(sd0, batches, tuple(args.hw), args.steps, args.iters,
+                     args.lr, args.wdecay, args.eps)
+    print("jax done:  ", [round(v, 4) for v in loss_j[:5]], "...",
+          round(loss_j[-1], 4), flush=True)
+
+    lt, lj = np.asarray(loss_t), np.asarray(loss_j)
+    rel = np.abs(lt - lj) / np.maximum(np.abs(lt), 1e-9)
+    tail = max(1, args.steps // 4)
+    summary = {
+        "steps": args.steps,
+        "step0_rel": float(rel[0]),
+        "first10_max_rel": float(rel[:10].max()),
+        "tail_mean_torch": float(lt[-tail:].mean()),
+        "tail_mean_jax": float(lj[-tail:].mean()),
+        "tail_mean_rel": float(abs(lt[-tail:].mean() - lj[-tail:].mean())
+                               / lt[-tail:].mean()),
+    }
+    with open(args.out, "w") as f:
+        json.dump({"summary": summary,
+                   "loss_torch": loss_t, "loss_jax": loss_j}, f, indent=1)
+    print(json.dumps(summary), flush=True)
+    print("wrote", args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
