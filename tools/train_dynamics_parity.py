@@ -28,6 +28,10 @@ import sys
 
 import numpy as np
 
+# the comparison target is torch on CPU; fp32 CPU-vs-CPU is the clean
+# setting and must not block dialing the (possibly down) TPU tunnel
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
 REF = "/root/reference"
 sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
 sys.path.insert(0, osp.dirname(osp.abspath(__file__)))  # train_reference_ckpt
