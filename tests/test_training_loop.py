@@ -151,3 +151,35 @@ class TestCurriculum:
         assert os.path.exists(things_final)
         # the things stage restored exactly the chairs final weights
         assert restored == [chairs_final]
+
+
+class TestSyntheticTrainCLI:
+    def test_rejects_fewer_samples_than_batch(self):
+        """--synthetic N with N < batch_size would make the drop-last
+        loader yield zero batches and the trainer spin forever — the CLI
+        must refuse up front with a readable message."""
+        from raft_tpu.cli.train import _synthetic_loader
+
+        cfg = TrainConfig(stage="chairs", batch_size=10)
+        with pytest.raises(SystemExit, match="zero batches"):
+            _synthetic_loader(8, cfg)
+
+    def test_loader_persists_and_feeds_real_pipeline(self, monkeypatch,
+                                                     tmp_path):
+        """The generated dataset lands under ~/.cache once (marker file),
+        and the loader yields real decoded+augmented+collated batches."""
+        monkeypatch.setenv("HOME", str(tmp_path))
+        from raft_tpu.cli.train import _synthetic_loader
+
+        cfg = TrainConfig(stage="chairs", batch_size=2, num_workers=2,
+                          image_size=(368, 496))
+        loader = _synthetic_loader(4, cfg)
+        batch = next(iter(loader))
+        assert batch["image1"].shape == (2, 368, 496, 3)
+        assert batch["flow"].dtype == np.float32
+        root = tmp_path / ".cache" / "raft_tpu" / "synthetic_chairs_4"
+        assert (root / ".complete").exists()
+        # second call reuses the dataset (marker short-circuits the write)
+        before = sorted(os.listdir(root))
+        _synthetic_loader(4, cfg)
+        assert sorted(os.listdir(root)) == before
