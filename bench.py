@@ -232,6 +232,39 @@ def main():
     stage = "chairs_" if (h, w) == IMAGE_HW else ""
     shape_tag = f"{stage}{h}x{w}"
 
+    # Probe the backend in a TIME-BOUNDED subprocess first: a wedged
+    # tunnel claim blocks jax.devices() in-process for ~25 min with no
+    # way to interrupt it (round-2 driver log lost 1,506 s to exactly
+    # this). A killed probe subprocess costs 4 min and leaves this
+    # process clean to emit the failure JSON immediately.
+    import subprocess
+
+    # boundedness is the point, not platform policing — an explicit
+    # JAX_PLATFORMS=cpu run passes the probe instantly. The probe must
+    # route through respect_cpu_request: the image's sitecustomize
+    # force-registers the axon plugin, and a bare subprocess would dial
+    # the tunnel even under JAX_PLATFORMS=cpu.
+    repo = os.path.dirname(os.path.abspath(__file__))
+    probe = (f"import sys; sys.path.insert(0, {repo!r}); "
+             "from raft_tpu.utils.platform import respect_cpu_request; "
+             "respect_cpu_request(); "
+             "import jax; d = jax.devices(); assert d; "
+             "print(d[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", probe], timeout=240,
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(r.stderr.strip().splitlines()[-1]
+                               if r.stderr.strip() else "probe failed")
+    except subprocess.TimeoutExpired:
+        log("backend probe timed out after 240s (tunnel down or claim "
+            "wedged)")
+        emit(f"raft_basic_train_{shape_tag}_backend_init_failed", 0.0)
+        return 1
+    except Exception as exc:
+        log(f"backend probe failed: {exc}")
+        emit(f"raft_basic_train_{shape_tag}_backend_init_failed", 0.0)
+        return 1
     try:
         devs = jax.devices()
         log(f"devices: {devs}")
