@@ -102,6 +102,16 @@ class FlowDataset:
         return len(self.image_list)
 
 
+def require_nonempty(dataset, name: str, root: str) -> None:
+    """Dataset scans glob the disk and come back empty when the data is not
+    staged; surface that as FileNotFoundError so callers (notably
+    ``trainer.run_validation``) can skip cleanly instead of crashing on an
+    empty reduction downstream."""
+    if len(dataset) == 0:
+        raise FileNotFoundError(
+            f"{name}: no samples found under '{root}' — dataset not staged")
+
+
 class ConcatDataset:
     """Minimal torch ConcatDataset analog for the mixing arithmetic."""
 
@@ -256,7 +266,19 @@ class HD1K(FlowDataset):
 
 def fetch_dataset(stage: str, image_size, data_root: str = "datasets",
                   train_ds: str = "C+T+K+S+H"):
-    """Stage-keyed training dataset mix (datasets.py:199-228)."""
+    """Stage-keyed training dataset mix (datasets.py:199-228).
+
+    Raises FileNotFoundError when the assembled mix has zero samples (the
+    class scans glob the disk and come back empty when data isn't staged);
+    an empty mix would otherwise surface as an opaque loader IndexError.
+    """
+    mix = _fetch_dataset(stage, image_size, data_root, train_ds)
+    require_nonempty(mix, f"stage {stage!r}", data_root)
+    return mix
+
+
+def _fetch_dataset(stage: str, image_size, data_root: str,
+                   train_ds: str):
     def p(name):
         return osp.join(data_root, name)
 

@@ -113,6 +113,11 @@ def _batched_eval(val, fwd, variables, mode: str, batch_size: int):
             yield flow[j], items[j][2]
 
 
+# FileNotFoundError on an unstaged dataset dir — the type
+# trainer.run_validation catches to skip cleanly
+_require_data = ds.require_nonempty
+
+
 def validate_chairs(variables, config: RAFTConfig,
                     iters: int = ITERS_EVAL["chairs"],
                     data_root: str = "datasets",
@@ -121,6 +126,8 @@ def validate_chairs(variables, config: RAFTConfig,
     fwd, _ = make_forward(config, iters)
     val = ds.FlyingChairs(split="validation",
                           root=osp.join(data_root, "FlyingChairs_release/data"))
+    _require_data(val, "FlyingChairs validation",
+                  osp.join(data_root, "FlyingChairs_release/data"))
     epe_list = []
     for flow, flow_gt in _batched_eval(val, fwd, variables, "sintel",
                                        batch_size):
@@ -141,6 +148,8 @@ def validate_sintel(variables, config: RAFTConfig,
     for dstype in ["clean", "final"]:
         val = ds.MpiSintel(split="training", root=osp.join(data_root, "Sintel"),
                            dstype=dstype)
+        _require_data(val, f"Sintel training/{dstype}",
+                      osp.join(data_root, "Sintel"))
         epe_list = []
         for flow, flow_gt in _batched_eval(val, fwd, variables, "sintel",
                                            batch_size):
@@ -169,6 +178,7 @@ def validate_kitti(variables, config: RAFTConfig,
     """
     fwd, _ = make_forward(config, iters)
     val = ds.KITTI(split="training", root=osp.join(data_root, "KITTI"))
+    _require_data(val, "KITTI training", osp.join(data_root, "KITTI"))
     out_list, epe_list = [], []
     for i in range(len(val)):
         img1, img2, flow_gt, valid_gt = val[i]
@@ -201,6 +211,8 @@ def create_sintel_submission(variables, config: RAFTConfig, iters: int = 32,
     for dstype in ["clean", "final"]:
         test = ds.MpiSintel(split="test", aug_params=None,
                             root=osp.join(data_root, "Sintel"), dstype=dstype)
+        _require_data(test, f"Sintel test/{dstype}",
+                      osp.join(data_root, "Sintel"))
         flow_prev, sequence_prev = None, None
         for test_id in range(len(test)):
             image1, image2, (sequence, frame) = test[test_id]
@@ -238,6 +250,7 @@ def create_kitti_submission(variables, config: RAFTConfig, iters: int = 24,
     fwd, _ = make_forward(config, iters)
     test = ds.KITTI(split="testing", aug_params=None,
                     root=osp.join(data_root, "KITTI"))
+    _require_data(test, "KITTI testing", osp.join(data_root, "KITTI"))
     os.makedirs(output_path, exist_ok=True)
     for test_id in range(len(test)):
         image1, image2, (frame_id,) = test[test_id]

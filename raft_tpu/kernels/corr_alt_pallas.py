@@ -89,8 +89,9 @@ def _alt_kernel(base_ref, wy_ref, wx_ref, f1_ref, f2_ref, out_ref,
                 ring, sems, win_ref, *, Q: int, K: int):
     """One grid step: Q queries of one batch element.
 
-    base_ref: SMEM (1, Q, 3) i32 — 8-aligned W start x0a, H start y0, and
-             the sub-offset off = x0 - x0a ∈ [0, 8)
+    base_ref: SMEM (1, Q, 3) i32 — x0a/8 (the 8-aligned W start divided by
+             8; the kernel multiplies back so Mosaic can prove tile
+             alignment), H start y0, and the sub-offset off = x0 - x0a
     wy/wx_ref: VMEM (1, Q, 1, 1) f32 — shared bilinear fracs
     f1_ref:  VMEM (1, Q, C) f32 — query feature rows
     f2_ref:  ANY (B, Hp, Wp, C) f32 — padded fmap2 levels, resident in HBM.
@@ -106,7 +107,12 @@ def _alt_kernel(base_ref, wy_ref, wx_ref, f1_ref, f2_ref, out_ref,
     b = pl.program_id(0)
 
     def window_copy(q, slot):
-        x0a = base_ref[0, q, 0]
+        # base_ref stores x0a/8: multiplying by 8 HERE is how Mosaic can
+        # PROVE the W slice start is tile-aligned — a runtime SMEM value
+        # alone fails its divisibility check ("Failed to prove that a tile
+        # index in dimension 2 is divisible by the tiling (8)", on-chip
+        # session C) even though the host computed it as (x0//8)*8.
+        x0a = base_ref[0, q, 0] * 8
         y0 = base_ref[0, q, 1]
         return pltpu.make_async_copy(
             f2_ref.at[b, pl.ds(y0, P), pl.ds(x0a, WSPAN), :],
@@ -172,8 +178,10 @@ def _prep_coords(Hl, Wl, x, y, radius):
     B, N = x.shape
     x0 = xf.astype(jnp.int32) - radius + PAD
     x0a = (x0 // 8) * 8                          # 8-aligned DMA start
+    # stored as x0a/8 (kernel multiplies back) so Mosaic can prove the
+    # slice start divisible by the (8,128) tile — see window_copy
     base = jnp.stack(
-        [x0a, yf.astype(jnp.int32) - radius + PAD, x0 - x0a],
+        [x0a // 8, yf.astype(jnp.int32) - radius + PAD, x0 - x0a],
         axis=-1)                                 # (B, N, 3)
     wy = (y - yf).astype(jnp.float32).reshape(B, N, 1, 1)
     wx = (x - xf).astype(jnp.float32).reshape(B, N, 1, 1)

@@ -35,7 +35,11 @@ from raft_tpu.models.corr import (
 )
 from raft_tpu.models.encoders import BasicEncoder, SmallEncoder
 from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
-from raft_tpu.ops.flow_ops import convex_upsample, initialize_flow, upflow8
+from raft_tpu.ops.flow_ops import (
+    convex_upsample_batched,
+    initialize_flow,
+    upflow8_batched,
+)
 from raft_tpu.ops.pooling import avg_pool2x2
 
 
@@ -142,20 +146,30 @@ class RAFT(nn.Module):
 
         small = cfg.small
 
+        # Upsampling happens OUTSIDE the scan, batched over all iterations:
+        # the per-iteration convex upsample materializes (B,H,W,9,8,8)-shaped
+        # tensors whose minor dims waste ~94% of the TPU (8,128) memory tile
+        # — measured at ~35% of the whole train step (see
+        # ops/flow_ops.convex_upsample_batched). In train mode the scan
+        # emits the low-res flow (+ mask) per iteration — a smaller stack
+        # than full-res predictions (576 bf16 channels at H/8 vs 2 fp32 at
+        # H). In test mode only the LAST iteration is upsampled, and the
+        # final mask rides the carry so nothing is stacked at all.
         def _iteration(update_block, carry, inp, coords0, corr_state):
-            net, coords1 = carry
+            net, coords1 = carry[0], carry[1]
             coords1 = jax.lax.stop_gradient(coords1)  # core/raft.py:123
             corr = lookup(corr_state, coords1)
             flow = coords1 - coords0
             net, up_mask, delta = update_block(
                 net, inp, corr.astype(dt), flow.astype(dt))
             coords1 = coords1 + delta.astype(jnp.float32)
+            if test_mode:
+                carry = ((net, coords1) if small
+                         else (net, coords1, up_mask))
+                return carry, None
             new_flow = coords1 - coords0
-            if small:
-                flow_up = upflow8(new_flow)
-            else:
-                flow_up = convex_upsample(new_flow, up_mask)
-            return (net, coords1), flow_up
+            ys = new_flow if small else (new_flow, up_mask)
+            return (net, coords1), ys
 
         if cfg.remat:
             policy = (jax.checkpoint_policies.checkpoint_dots
@@ -171,11 +185,27 @@ class RAFT(nn.Module):
             out_axes=0,
             length=iters,
         )
-        (net, coords1), flow_predictions = scan(
-            self.update_block, (net, coords1), inp, coords0, corr_state)
+        init_carry = (net, coords1)
+        if test_mode and not small:
+            init_carry = (net, coords1,
+                          jnp.zeros((B, H // 8, W // 8, 64 * 9), dt))
+        carry, ys = scan(
+            self.update_block, init_carry, inp, coords0, corr_state)
+        coords1 = carry[1]
+        flow_lr = coords1 - coords0
 
         if test_mode:
-            return coords1 - coords0, flow_predictions[-1]
+            if small:
+                flow_up = upflow8_batched(flow_lr[None])[0]
+            else:
+                flow_up = convex_upsample_batched(flow_lr[None],
+                                                  carry[2][None])[0]
+            return flow_lr, flow_up
+
+        if small:
+            flow_predictions = upflow8_batched(ys)
+        else:
+            flow_predictions = convex_upsample_batched(*ys)
         return flow_predictions
 
 

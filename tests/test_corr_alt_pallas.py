@@ -120,7 +120,9 @@ def test_window_dma_in_bounds_at_extreme_coords():
             y = jnp.asarray([[-1e4, 1e4, Hl + 30.0]])
             base, _, _ = corr_alt_pallas._prep_coords(
                 Hp - 2 * PAD, Wp - 2 * PAD - extra, x, y, radius)
-            x0a = np.asarray(base[..., 0])
+            # base stores x0a/8 (the kernel multiplies back by 8 so Mosaic
+            # can prove tile-aligned slicing); recover the DMA start
+            x0a = np.asarray(base[..., 0]) * 8
             y0 = np.asarray(base[..., 1])
             off = np.asarray(base[..., 2])
             assert (x0a >= 0).all() and (y0 >= 0).all()

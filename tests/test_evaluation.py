@@ -269,13 +269,17 @@ class TestSintelSubmission:
         from raft_tpu.models import RAFT
 
         src = osp.join(osp.dirname(__file__), "..", "demo-frames")
-        scene = tmp_path / "Sintel" / "test" / "clean" / "ambush_2"
-        os.makedirs(scene)
-        for i, name in enumerate(["frame_0016.png", "frame_0017.png",
-                                  "frame_0018.png"]):
-            img = Image.open(osp.join(src, name))
-            # small crop keeps CPU runtime sane; still real pixels
-            img.crop((0, 0, 192, 128)).save(scene / f"frame_{i:04d}.png")
+        # stage BOTH dstypes: the writer requires a complete test tree
+        # (matching the reference, whose os.listdir raises on a missing
+        # pass) and our empty-scan guard does the same
+        for dstype in ("clean", "final"):
+            scene = tmp_path / "Sintel" / "test" / dstype / "ambush_2"
+            os.makedirs(scene)
+            for i, name in enumerate(["frame_0016.png", "frame_0017.png",
+                                      "frame_0018.png"]):
+                img = Image.open(osp.join(src, name))
+                # small crop keeps CPU runtime sane; still real pixels
+                img.crop((0, 0, 192, 128)).save(scene / f"frame_{i:04d}.png")
 
         cfg = RAFTConfig(small=True)
         variables = RAFT(cfg).init(
@@ -330,3 +334,41 @@ class TestSintelSubmission:
         uv = frame_utils.read_flow(
             str(tmp_path / "sub" / "clean" / "alley_1" / "frame0002.flo"))
         np.testing.assert_allclose(uv, 2.0)  # warm-start forward's output
+
+
+class TestMissingDatasets:
+    """Unstaged data must surface as FileNotFoundError, not an empty
+    reduction: the trainer's mid-run validation (trainer.run_validation)
+    catches exactly that type to skip — an escaping ValueError killed a
+    real on-chip 450-step run at its step-200 validation."""
+
+    def test_validate_chairs_raises_filenotfound(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="FlyingChairs"):
+            ev.validate_chairs(None, RAFTConfig(small=True),
+                               data_root=str(tmp_path))
+
+    def test_validate_sintel_raises_filenotfound(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="Sintel"):
+            ev.validate_sintel(None, RAFTConfig(small=True),
+                               data_root=str(tmp_path))
+
+    def test_validate_kitti_raises_filenotfound(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="KITTI"):
+            ev.validate_kitti(None, RAFTConfig(small=True),
+                              data_root=str(tmp_path))
+
+    def test_run_validation_skips_all_missing(self, tmp_path, capsys):
+        from raft_tpu.training.trainer import run_validation
+
+        results = run_validation(None, RAFTConfig(small=True),
+                                 ["chairs", "sintel", "kitti"],
+                                 str(tmp_path))
+        assert results == {}
+        out = capsys.readouterr().out
+        assert out.count("skipped") == 3
+
+    def test_fetch_dataset_empty_mix_raises(self, tmp_path):
+        from raft_tpu.data.datasets import fetch_dataset
+
+        with pytest.raises(FileNotFoundError, match="chairs"):
+            fetch_dataset("chairs", (368, 496), data_root=str(tmp_path))

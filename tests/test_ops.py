@@ -139,3 +139,33 @@ class TestInputPadder:
         padder = InputPadder((1, 375, 1242, 3), mode="kitti")
         l, r, t, b = padder._pad
         assert t == 0 and b == 1
+
+    def test_batched_matches_per_frame_oracle(self, rng):
+        """convex_upsample_batched must be numerically interchangeable with
+        the per-frame oracle: it is the same softmax + fp32 convex
+        combination, only laid out pixels-on-lanes for the TPU memory tile
+        (the per-iteration form burned ~35% of the measured train step)."""
+        from raft_tpu.ops.flow_ops import convex_upsample_batched
+
+        T, B, H, W = 3, 2, 5, 6
+        flow = rng.randn(T, B, H, W, 2).astype(np.float32)
+        mask = rng.randn(T, B, H, W, 576).astype(np.float32)
+
+        got = np.asarray(convex_upsample_batched(jnp.asarray(flow),
+                                                 jnp.asarray(mask)))
+        assert got.shape == (T, B, 8 * H, 8 * W, 2)
+        for t in range(T):
+            want = np.asarray(convex_upsample(jnp.asarray(flow[t]),
+                                              jnp.asarray(mask[t])))
+            np.testing.assert_allclose(got[t], want, atol=1e-5, rtol=1e-5)
+
+    def test_upflow8_batched_matches_per_frame(self, rng):
+        from raft_tpu.ops.flow_ops import upflow8_batched
+
+        T, B, H, W = 2, 2, 4, 5
+        flow = rng.randn(T, B, H, W, 2).astype(np.float32)
+        got = np.asarray(upflow8_batched(jnp.asarray(flow)))
+        assert got.shape == (T, B, 8 * H, 8 * W, 2)
+        for t in range(T):
+            want = np.asarray(upflow8(jnp.asarray(flow[t])))
+            np.testing.assert_allclose(got[t], want, atol=1e-5, rtol=1e-5)

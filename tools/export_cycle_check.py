@@ -8,10 +8,15 @@ jit path. Timing uses a host value-fetch fence (block_until_ready lies on
 the axon backend — BENCH_NOTES methodology).
 """
 
+import os.path as osp
 import sys
 import time
 
 import numpy as np
+
+# runnable as `python tools/export_cycle_check.py` — put the repo root on
+# the path so raft_tpu imports without an install step
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
 
 from raft_tpu.utils.platform import setup_cli
 

@@ -7,7 +7,7 @@
 # Produces, inside the repo (for the round-end snapshot):
 #   ONCHIP_r03.log           — raw session log (VERDICT r2 missing #2)
 #   BENCH_DEFAULTS.json      — best MEASURED bench config (bench.py reads it)
-#   runs/metrics.jsonl       — 500-step training loss series (missing #3)
+#   runs/r3synth/metrics.jsonl — 500-step training loss series (missing #3)
 set -u
 cd /root/repo
 OUT=${1:-/tmp/onchip_round3.out}
@@ -85,7 +85,8 @@ try:
 except OSError:
     argv += ["--batch", "6"]
 print("profile_step", argv, flush=True)
-sys.exit(profile_step.main(argv))
+profile_step.main(argv)  # returns avg step seconds — not an exit code
+sys.exit(0)
 PYEOF
 step trace_summary 1200 python -m raft_tpu.cli.trace_summary \
     /tmp/raft_trace_r3 --top 30
@@ -124,8 +125,13 @@ cp "$OUT" /root/repo/ONCHIP_r03.log 2>/dev/null || true
 # artifacts-only commit so a round-end snapshot can't lose the evidence
 cp /root/.cache/raft_tpu/ref_ckpt/trained_parity.json \
     /root/repo/TRAINED_PARITY_onchip.json 2>/dev/null || true
-cd /root/repo && git add -A ONCHIP_r03.log BENCH_DEFAULTS.json \
-    runs/metrics.jsonl TRAINED_PARITY_onchip.json 2>/dev/null
+# add each artifact separately: one missing pathspec must not abort the
+# whole staging (it silently killed the pass-1 artifact commit)
+cd /root/repo
+for f in ONCHIP_r03.log BENCH_DEFAULTS.json runs/r3synth/metrics.jsonl \
+         TRAINED_PARITY_onchip.json; do
+    git add "$f" 2>/dev/null || true
+done
 git diff --cached --quiet || git commit -q -m \
     "On-chip round-3 artifacts: bench ladder, training run, kernel shootout" \
     -m "No-Verification-Needed: measurement logs and recorded defaults only"

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-3 follow-up ladder, informed by the first ladder's measurements:
+#   b_bf16_b8  (bf16 vol, b8, no remat)  16.04 pairs/s  <- best
+#   a_fp32_b8  (fp32 vol, b8, no remat)  12.63
+#   c_bf16_dots(bf16 vol, b12, remat)    13.77          <- remat hurts
+# Untried: larger batch WITHOUT remat (bf16 volumes cut temp memory, so
+# b10/b12 may fit where r2's fp32 b8 was borderline). Run after the main
+# runbook so the chip is never double-booked.
+set -u
+cd /root/repo
+OUT=${1:-/tmp/onchip_round3b.out}
+MARK=/root/.cache/raft_tpu/r3_markers
+LADDER=/root/.cache/raft_tpu/r3_ladder
+mkdir -p "$MARK" "$LADDER"
+log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
+bench_cfg() {
+    local tag=$1 tmo=$2; shift 2
+    if [ -e "$MARK/bench_$tag" ]; then log "skip bench_$tag"; return 0; fi
+    log "begin bench_$tag: $*"
+    if timeout "$tmo" python bench.py --steps 10 "$@" \
+            > "$LADDER/$tag.json" 2>> "$OUT"; then
+        cat "$LADDER/$tag.json" >> "$OUT"
+        touch "$MARK/bench_$tag"; log "done bench_$tag"
+    else
+        log "FAILED bench_$tag rc=$?"; cat "$LADDER/$tag.json" >> "$OUT"
+    fi
+    cp "$OUT" /root/repo/ONCHIP_r03b.log 2>/dev/null || true
+}
+
+# g_*: re-measure after moving convex upsampling out of the scan into one
+# batched lane-tiled op (ops/flow_ops.convex_upsample_batched) — the XProf
+# trace attributed ~35% of the 500 ms step to the per-iteration form's
+# (…,9,8,8) tile padding. Same flags as b_bf16_b8 for apples-to-apples.
+bench_cfg g_upsample_b8  1800 --batches 8 --corr-dtype bfloat16 --no-remat
+bench_cfg f_bf16_b12     1800 --batches 12 10 --corr-dtype bfloat16 --no-remat
+step_pick() {
+    python tools/pick_bench_defaults.py "$LADDER" >> "$OUT" 2>&1
+    cp "$OUT" /root/repo/ONCHIP_r03b.log 2>/dev/null || true
+}
+step_pick
+log "round3b complete"
+# artifacts-only commit so a round-end snapshot can't lose the evidence
+for f in ONCHIP_r03b.log BENCH_DEFAULTS.json; do
+    git add "$f" 2>/dev/null || true
+done
+git diff --cached --quiet || git commit -q -m \
+    "On-chip round-3b ladder artifacts" \
+    -m "No-Verification-Needed: measurement logs and recorded defaults only"
