@@ -19,6 +19,7 @@ max envelope).
 from __future__ import annotations
 
 import argparse
+import sys
 
 import numpy as np
 
@@ -53,7 +54,7 @@ def main(argv=None):
     p.add_argument("--levels", type=int, default=4)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--impls", nargs="+",
-                   default=["gather", "onehot", "pallas", "alt",
+                   default=["gather", "onehot", "onehot_t", "pallas", "alt",
                             "alt_pallas"])
     p.add_argument("--grad", action="store_true",
                    help="bench value+grad (the train-step cost) instead of "
@@ -70,7 +71,9 @@ def main(argv=None):
                                   pad_f2_pyramid, pad_pyramid,
                                   pallas_available)
     from raft_tpu.models.corr import (alt_corr_lookup, build_corr_pyramid,
-                                      corr_lookup, corr_lookup_onehot)
+                                      build_corr_pyramid_t, corr_lookup,
+                                      corr_lookup_onehot,
+                                      corr_lookup_onehot_t)
     from raft_tpu.ops.pooling import avg_pool2x2
 
     B, (H, W), C = args.batch, args.hw, args.dim
@@ -105,12 +108,28 @@ def main(argv=None):
             d[:, :v.shape[1], PAD:PAD + v.shape[2], PAD:PAD + v.shape[3]]
             for d, v in zip(d_pp, pyramid))
 
+    def transpose_grads(d_t):
+        """onehot_t cotangents (B,Hl,Wl,N) -> the (B,N,Hl,Wl) layout the
+        other volume impls produce, so grad-mode parity compares
+        like-with-like (a raveled permuted layout reads as rel diff ~1)."""
+        return tuple(jnp.transpose(d, (0, 3, 1, 2)) for d in d_t)
+
+    # built only when requested: the extra transposed pyramid costs full
+    # volume memory and can shift OOM behavior of other impls' runs
+    pyramid_t = (jax.block_until_ready(tuple(
+        v.astype(args.corr_dtype) for v in
+        build_corr_pyramid_t(fmap1, fmap2, args.levels)))
+        if "onehot_t" in args.impls else None)
+
     # per impl: (volume input to differentiate, lookup fn, grad postprocess)
     impls = {
         "gather": (pyramid,
                    lambda v, c: corr_lookup(v, c, args.radius), None),
         "onehot": (pyramid,
                    lambda v, c: corr_lookup_onehot(v, c, args.radius), None),
+        "onehot_t": (pyramid_t,
+                     lambda v, c: corr_lookup_onehot_t(v, c, args.radius),
+                     transpose_grads),
         "pallas": (pyramid_pp,
                    lambda v, c: corr_lookup_pallas(
                        v, c, args.radius, prepadded=True), unpad_grads),
@@ -141,10 +160,12 @@ def main(argv=None):
 
     reference = None
     results = {}
+    failed = []
     for name in args.impls:
         if name not in impls:
             print(f"{name:>8}: unknown impl (choose from "
                   f"{', '.join(impls)})")
+            failed.append(name)  # a typo'd runbook row must not exit 0
             continue
         if name in ("pallas", "alt_pallas") and not pallas_available():
             print(f"{name:>8}: skipped (no TPU backend)")
@@ -154,6 +175,7 @@ def main(argv=None):
             dt, out = bench_fn(run, coords, vols, iters=args.iters)
         except Exception as e:
             print(f"{name:>8}: FAILED {type(e).__name__}: {e}")
+            failed.append(name)
             continue
         # comparable output: the lookup itself, or — in grad mode — the
         # sum-of-squares primal plus every gradient leaf, flattened (a
@@ -162,23 +184,33 @@ def main(argv=None):
         # grad-mode diff vs the volume-based impls is structural, not a bug.
         if args.grad:
             val, grads = out
+            # grads normalized SEPARATELY from the primal: the primal is
+            # a sum of squares orders of magnitude above any gradient
+            # entry, and a shared max-|reference| denominator once hid a
+            # fully permuted gradient layout behind a ~1e-5 "diff"
             cmp = np.concatenate(
-                [np.ravel(val)]
-                + [np.ravel(l) for l in jax.tree_util.tree_leaves(grads)])
+                [np.ravel(l) for l in jax.tree_util.tree_leaves(grads)])
+            cmp_primal = float(np.ravel(val)[0])
         else:
             cmp = np.asarray(out)
+            cmp_primal = None
         if reference is None:
-            reference = cmp
+            reference = (cmp, cmp_primal)
             diff = "max|Δ|=0.00e+00"
-        elif cmp.shape != reference.shape:
+        elif cmp.shape != reference[0].shape:
             # 'alt' differentiates (fmap1, f2_pyr) while the volume impls
             # differentiate the pyramid — gradient vectors aren't
             # comparable across that boundary
             diff = "Δ=n/a (different grad structure)"
         else:
-            denom = (max(float(np.abs(reference).max()), 1e-9)
+            ref, ref_primal = reference
+            denom = (max(float(np.abs(ref).max()), 1e-9)
                      if args.grad else 1.0)
-            diff = f"max|Δ|={float(np.abs(cmp - reference).max()) / denom:.2e}"
+            diff = f"max|Δ|={float(np.abs(cmp - ref).max()) / denom:.2e}"
+            if args.grad:
+                prim_rel = (abs(cmp_primal - ref_primal)
+                            / max(abs(ref_primal), 1e-9))
+                diff += f" primalΔ={prim_rel:.1e}"
         results[name] = dt
         queries_per_s = B * H * W / dt
         print(f"{name:>8}: {dt * 1e3:8.3f} ms  "
@@ -187,8 +219,12 @@ def main(argv=None):
     if results:
         fastest = min(results, key=results.get)
         print(f"fastest: {fastest}")
-    return results
+    return results, failed
 
 
 if __name__ == "__main__":
-    main()
+    # a run where any REQUESTED impl failed must exit nonzero — runbook
+    # markers treat exit 0 as "measured", and a worker crash that failed
+    # every impl once masqueraded as a completed shootout row
+    _, _failed = main()
+    sys.exit(1 if _failed else 0)

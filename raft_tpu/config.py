@@ -31,15 +31,14 @@ class RAFTConfig:
     mixed_precision: bool = False
     corr_levels: int = 4
     # lookup backend for the materialized pyramid: 'gather' (flattened-index
-    # take), 'onehot' (one-hot selection GEMMs), or 'pallas' (vectorized
-    # mask-select kernel, TPU only). Default: 'onehot', on partial on-chip
-    # evidence (BENCH_NOTES.md, v5e-1 chairs geometry): gather measured
-    # 364 ms fwd and a disqualifying 3967 ms fwd+grad per lookup (TPU
-    # scatter lowering); onehot measured 170 ms fwd, its backward is the
-    # transpose of the same GEMMs (same cost class, not yet measured on
-    # chip — the tunnel dropped first). Re-benchmark with
-    # `python -m raft_tpu.cli.corr_bench` (+ --grad); 'pallas' may take
-    # over once its backward is validated on hardware.
+    # take), 'onehot' (one-hot selection GEMMs), 'onehot_t' (one-hot
+    # selection over the TRANSPOSED pixels-on-lanes volume — see
+    # models/corr.build_corr_pyramid_t), or 'pallas' (vectorized
+    # mask-select kernel, TPU only). On-chip at chairs geometry
+    # (BENCH_NOTES.md r3, v5e-1, per lookup): gather 294 ms fwd (scatter
+    # lowering makes its backward disqualifying); onehot 10.8 ms fwd /
+    # 14.0 fwd+grad; pallas 15.1 / 27.5. Re-benchmark with
+    # `python -m raft_tpu.cli.corr_bench` (+ --grad).
     corr_impl: str = "onehot"
     # storage dtype of the materialized correlation pyramid. The reference
     # computes correlation in an fp32 island (core/raft.py:102-103) and so
@@ -78,12 +77,12 @@ class RAFTConfig:
     remat_policy: str = "full"
 
     def __post_init__(self):
-        if self.corr_impl not in ("gather", "onehot", "pallas"):
+        if self.corr_impl not in ("gather", "onehot", "onehot_t", "pallas"):
             raise ValueError(
-                f"corr_impl={self.corr_impl!r}: choose gather, onehot, or "
-                "pallas (the memory-efficient alternate path is selected "
-                "by alternate_corr=True, with corr_impl picking its "
-                "XLA/pallas backend)")
+                f"corr_impl={self.corr_impl!r}: choose gather, onehot, "
+                "onehot_t, or pallas (the memory-efficient alternate path "
+                "is selected by alternate_corr=True, with corr_impl "
+                "picking its XLA/pallas backend)")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy={self.remat_policy!r}: choose 'full' or "

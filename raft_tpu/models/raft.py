@@ -30,8 +30,10 @@ from raft_tpu.models.corr import (
     CorrBlock,
     alt_corr_lookup,
     build_corr_pyramid,
+    build_corr_pyramid_t,
     corr_lookup,
     corr_lookup_onehot,
+    corr_lookup_onehot_t,
 )
 from raft_tpu.models.encoders import BasicEncoder, SmallEncoder
 from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
@@ -114,6 +116,14 @@ class RAFT(nn.Module):
                     f1, f2_pyr = state
                     return alt_corr_lookup(f1, f2_pyr, coords,
                                            cfg.corr_radius)
+        elif cfg.corr_impl == "onehot_t":
+            # transposed (pixels-on-lanes) volume — see build_corr_pyramid_t
+            corr_state = tuple(
+                v.astype(cfg.corr_dtype)
+                for v in build_corr_pyramid_t(fmap1, fmap2, cfg.corr_levels))
+
+            def lookup(state, coords):
+                return corr_lookup_onehot_t(state, coords, cfg.corr_radius)
         else:
             corr_state = tuple(
                 v.astype(cfg.corr_dtype)

@@ -35,15 +35,14 @@ class Logger:
         except Exception:
             self.writer = None
 
-    def _print_status(self):
+    def _print_status(self, means: Dict[str, float]):
         lr = float(self.lr_fn(self.total_steps)) if self.lr_fn else 0.0
         dt = time.perf_counter() - self._t0
         ips = (self.total_steps - self._last_steps) / max(dt, 1e-9)
         self._t0, self._last_steps = time.perf_counter(), self.total_steps
         # training status, mirroring train.py:97-103's fixed-width line
-        keys = sorted(self.running)
         metrics_str = ("".join(
-            f"{self.running[k] / self.sum_freq:10.4f}, " for k in keys))
+            f"{means[k]:10.4f}, " for k in sorted(means)))
         print(f"[{self.total_steps + 1:6d}, {lr:10.7f}] {metrics_str}"
               f"({ips:.2f} steps/s)", flush=True)
 
@@ -52,10 +51,39 @@ class Logger:
         for k, v in metrics.items():
             self.running[k] = self.running.get(k, 0.0) + float(v)
         if self.total_steps % self.sum_freq == self.sum_freq - 1:
-            self._print_status()
-            self.write_dict(
-                {k: v / self.sum_freq for k, v in self.running.items()})
+            means = {k: v / self.sum_freq for k, v in self.running.items()}
+            self._print_status(means)
+            self.write_dict(means)
             self.running = {}
+
+    def start_at(self, step: int):
+        """Align the step counter to a restored train state. Also pins the
+        steps/s baseline: without this, the first window after a resume
+        computes (restored_steps + window) / (one window's wall time) —
+        an arbitrary, usually inflated rate."""
+        self.total_steps = step
+        self._last_steps = step
+        self._t0 = time.perf_counter()
+
+    def push_sums(self, sums: Dict[str, float], n: int):
+        """Ingest ``n`` steps' worth of metric SUMS at once and flush a
+        status line + record for the window.
+
+        Exists for device-side accumulation: fetching per-step scalars
+        costs one host<->device round trip per step, which on a remote
+        TPU backend caps the whole training loop at ~1/RTT steps/s
+        (measured: 0.72 steps/s against a ~3 steps/s device). The trainer
+        sums metrics on device and fetches once per ``sum_freq`` window,
+        flushing at the same ``total_steps % sum_freq == sum_freq - 1``
+        boundaries as :meth:`push` so records/labels stay step-aligned
+        with the reference logger (train.py:97-103).
+        """
+        if n <= 0:
+            return
+        self.total_steps += n
+        means = {k: float(v) / n for k, v in sums.items()}
+        self._print_status(means)
+        self.write_dict(means)
 
     def write_dict(self, results: Dict[str, float]):
         rec = {"step": self.total_steps}

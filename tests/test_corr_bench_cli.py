@@ -27,13 +27,14 @@ ARGS = ["--batch", "1", "--hw", "8", "12", "--dim", "16", "--radius", "2",
 
 def _diffs(capsys):
     out = capsys.readouterr().out
-    return out, [float(line.split("max|Δ|=")[1])
+    return out, [float(line.split("max|Δ|=")[1].split()[0])
                  for line in out.splitlines() if "max|Δ|" in line]
 
 
 def test_forward_all_impls(capsys):
-    results = corr_bench.main(
+    results, failed = corr_bench.main(
         ARGS + ["--impls", "gather", "onehot", "pallas", "alt"])
+    assert not failed
     assert set(results) == {"gather", "onehot", "pallas", "alt"}
     out, diffs = _diffs(capsys)
     assert len(diffs) == 4 and max(diffs) < 1e-4, out
@@ -43,8 +44,9 @@ def test_grad_mode_parity_includes_gradients(capsys):
     """Grad-mode parity compares gradient leaves, not just the primal —
     a wrong backward (e.g. in the Pallas scatter kernel or its unpad
     slicing) must surface as a large max|Δ| here."""
-    results = corr_bench.main(
+    results, failed = corr_bench.main(
         ARGS + ["--grad", "--impls", "gather", "onehot", "pallas"])
+    assert not failed
     assert set(results) == {"gather", "onehot", "pallas"}
     out, diffs = _diffs(capsys)
     assert len(diffs) == 3 and max(diffs) < 1e-4, out
@@ -67,3 +69,20 @@ def test_grad_mode_flags_a_broken_backward(capsys):
     finally:
         corr_pallas._lookup.defvjp(corr_pallas._lookup_fwd,
                                    corr_pallas._lookup_bwd)
+
+
+def test_grad_mode_onehot_t_layout_normalized(capsys):
+    """onehot_t's volume cotangents are produced in (B,Hl,Wl,N); the CLI
+    must transpose them back before parity, else a correct backward reads
+    as rel diff ~1 (and with the old primal-dominated denominator, a
+    WRONG one read as ~1e-5)."""
+    results, failed = corr_bench.main(
+        ARGS + ["--grad", "--impls", "onehot", "onehot_t"])
+    assert not failed
+    out, diffs = _diffs(capsys)
+    assert len(diffs) == 2 and max(diffs) < 1e-4, out
+
+
+def test_unknown_impl_reports_failure():
+    _, failed = corr_bench.main(ARGS + ["--impls", "onehot", "onehott"])
+    assert failed == ["onehott"]

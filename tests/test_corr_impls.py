@@ -229,3 +229,74 @@ class TestModelIntegration:
         assert per_iter[-1] < 5e-3, f"drift blow-up: {per_iter}"
         growth = per_iter[1:] / np.maximum(per_iter[:-1], 1e-12)
         assert growth.max() < 10.0, f"non-linear amplification: {per_iter}"
+
+
+class TestOnehotTParity:
+    """The transposed (pixels-on-lanes) volume path must be numerically
+    interchangeable with the gather oracle: same dot products (identical
+    einsum contraction), same one-hot window select + separable lerp —
+    only the storage order differs (see build_corr_pyramid_t)."""
+
+    def test_pyramid_is_transposed_pyramid(self):
+        from raft_tpu.models.corr import (build_corr_pyramid,
+                                          build_corr_pyramid_t)
+
+        # one set of fmaps, both builders — self-contained on purpose
+        # (regenerating "the fixture's" arrays from a copied seed would
+        # silently decouple from fixture edits)
+        rng = np.random.RandomState(11)
+        fmap1 = jnp.asarray(rng.randn(2, 8, 12, 16).astype(np.float32))
+        fmap2 = jnp.asarray(rng.randn(2, 8, 12, 16).astype(np.float32))
+        pyr = build_corr_pyramid(fmap1, fmap2, num_levels=3)
+        pyr_t = build_corr_pyramid_t(fmap1, fmap2, num_levels=3)
+        assert len(pyr) == len(pyr_t)
+        for v, vt in zip(pyr, pyr_t):
+            want = np.asarray(v).transpose(0, 2, 3, 1)   # (B, Hl, Wl, N)
+            np.testing.assert_allclose(np.asarray(vt), want,
+                                       atol=1e-6, rtol=1e-6)
+
+    def test_matches_gather(self, setup):
+        from raft_tpu.models.corr import corr_lookup_onehot_t
+
+        pyramid, coords = setup
+        pyr_t = [jnp.transpose(v, (0, 2, 3, 1)) for v in pyramid]
+        want = np.asarray(corr_lookup(pyramid, coords, RADIUS))
+        got = np.asarray(corr_lookup_onehot_t(pyr_t, coords, RADIUS))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_grad_matches_gather(self, setup):
+        from raft_tpu.models.corr import corr_lookup_onehot_t
+
+        pyramid, coords = setup
+        pyr_t = [jnp.transpose(v, (0, 2, 3, 1)) for v in pyramid]
+
+        g_want = jax.grad(
+            lambda p: jnp.sum(corr_lookup(p, coords, RADIUS) ** 2)
+        )(list(pyramid))
+        g_got = jax.grad(
+            lambda p: jnp.sum(corr_lookup_onehot_t(p, coords, RADIUS) ** 2)
+        )(list(pyr_t))
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b).transpose(0, 2, 3, 1),
+                atol=1e-4, rtol=1e-4)
+
+    def test_model_forward_same_flow(self):
+        """RAFT with corr_impl='onehot_t' produces the same flow as the
+        default within fp32 reassociation noise."""
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.models import RAFT
+
+        rng = np.random.RandomState(3)
+        i1 = jnp.asarray(rng.rand(1, 32, 48, 3).astype(np.float32) * 255)
+        i2 = jnp.asarray(rng.rand(1, 32, 48, 3).astype(np.float32) * 255)
+        outs = {}
+        for impl in ("onehot", "onehot_t"):
+            cfg = RAFTConfig(small=True, corr_impl=impl)
+            variables = RAFT(cfg).init(jax.random.PRNGKey(0), i1, i2,
+                                       iters=1)
+            _, flow = RAFT(cfg).apply(variables, i1, i2, iters=3,
+                                      test_mode=True)
+            outs[impl] = np.asarray(flow)
+        np.testing.assert_allclose(outs["onehot_t"], outs["onehot"],
+                                   atol=1e-4, rtol=1e-4)

@@ -1,0 +1,69 @@
+#!/bin/bash
+# Round-3c: decide the transposed-volume lookup (corr_impl=onehot_t) on
+# MEASURED numbers, rerun the bf16 shootout row that the pass-1 worker
+# crash swallowed, and redo the train450 -> resume pair cleanly (pass-2's
+# train450 hit a live-edit import race; train500_resume trained 0->500
+# with nothing to resume from). Marker-guarded like the main runbook.
+set -u
+cd /root/repo
+OUT=${1:-/tmp/onchip_round3c.out}
+MARK=/root/.cache/raft_tpu/r3_markers
+LADDER=/root/.cache/raft_tpu/r3_ladder
+mkdir -p "$MARK" "$LADDER"
+log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
+step() {
+    local name=$1 tmo=$2; shift 2
+    if [ -e "$MARK/$name" ]; then log "skip $name (done)"; return 0; fi
+    log "begin $name"
+    if timeout "$tmo" "$@" >> "$OUT" 2>&1; then
+        touch "$MARK/$name"; log "done $name"
+    else
+        log "FAILED rc=$? $name"
+    fi
+    cp "$OUT" /root/repo/ONCHIP_r03c.log 2>/dev/null || true
+}
+bench_cfg() {
+    local tag=$1 tmo=$2; shift 2
+    if [ -e "$MARK/bench_$tag" ]; then log "skip bench_$tag"; return 0; fi
+    log "begin bench_$tag: $*"
+    if timeout "$tmo" python bench.py --steps 10 "$@" \
+            > "$LADDER/$tag.json" 2>> "$OUT"; then
+        cat "$LADDER/$tag.json" >> "$OUT"
+        touch "$MARK/bench_$tag"; log "done bench_$tag"
+    else
+        log "FAILED bench_$tag rc=$?"; cat "$LADDER/$tag.json" >> "$OUT"
+    fi
+    cp "$OUT" /root/repo/ONCHIP_r03c.log 2>/dev/null || true
+}
+
+# ---- 1. onehot_t lookup decision (isolated, then whole-step) -----------
+step t_fwd 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls onehot onehot_t
+step t_grad 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls onehot onehot_t --grad
+# the missing bf16 row (pass-1 worker crash) + the onehot_t bf16 variant
+step t_bf16 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls gather onehot onehot_t --grad --corr-dtype bfloat16
+bench_cfg h_onehot_t_b8 1800 --batches 8 --corr-dtype bfloat16 --no-remat \
+    --corr-impl onehot_t
+step pick_defaults_c 120 python tools/pick_bench_defaults.py "$LADDER"
+
+# ---- 2. clean train450 -> resume pair (quiet host, fixed code) ---------
+rm -rf /root/.cache/raft_tpu/r3_ck
+step train450c 2400 python -m raft_tpu.cli.train --name r3synth \
+    --stage chairs --mixed_precision --synthetic 64 --num_steps 450 \
+    --val_freq 200 --batch_size 6 --num_workers 4 \
+    --checkpoint_dir /root/.cache/raft_tpu/r3_ck --log_dir runs
+step train500c_resume 1800 python -m raft_tpu.cli.train --name r3synth \
+    --stage chairs --mixed_precision --synthetic 64 --num_steps 500 \
+    --val_freq 200 --batch_size 6 --num_workers 4 --resume \
+    --checkpoint_dir /root/.cache/raft_tpu/r3_ck --log_dir runs
+
+log "round3c complete"
+cp "$OUT" /root/repo/ONCHIP_r03c.log 2>/dev/null || true
+for f in ONCHIP_r03c.log BENCH_DEFAULTS.json runs/r3synth/metrics.jsonl; do
+    git add "$f" 2>/dev/null || true
+done
+git diff --cached --quiet || git commit -q -m \
+    "On-chip round-3c artifacts: onehot_t shootout, clean train/resume pair" \
+    -m "No-Verification-Needed: measurement logs and recorded defaults only"

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-3d: trained-weights parity at exact fp32 matmul precision.
+# XLA's default fp32 conv on TPU runs multi-pass bf16; through 20
+# recurrent refinement iterations that costs ~0.13 px max vs the torch
+# CPU reference. --matmul-precision highest (now the tool default)
+# removes it; the torch-side flows come from the new on-disk cache, so
+# only the TPU forwards rerun.
+set -u
+cd /root/repo
+OUT=${1:-/tmp/onchip_round3d.out}
+MARK=/root/.cache/raft_tpu/r3_markers
+mkdir -p "$MARK"
+log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
+step() {
+    local name=$1 tmo=$2; shift 2
+    if [ -e "$MARK/$name" ]; then log "skip $name (done)"; return 0; fi
+    log "begin $name"
+    if timeout "$tmo" "$@" >> "$OUT" 2>&1; then
+        touch "$MARK/$name"; log "done $name"
+    else
+        log "FAILED rc=$? $name"
+    fi
+    cp "$OUT" /root/repo/ONCHIP_r03d.log 2>/dev/null || true
+}
+
+step trained_parity_exact 2400 python tools/trained_parity.py
+
+log "round3d complete"
+cp /root/.cache/raft_tpu/ref_ckpt/trained_parity.json \
+    /root/repo/TRAINED_PARITY_onchip.json 2>/dev/null || true
+for f in ONCHIP_r03d.log TRAINED_PARITY_onchip.json; do
+    git add "$f" 2>/dev/null || true
+done
+git diff --cached --quiet || git commit -q -m \
+    "On-chip round-3d artifacts: exact-precision trained-weights parity" \
+    -m "No-Verification-Needed: measurement logs and records only"
