@@ -137,7 +137,7 @@ _DEFAULTS_SCHEMA = {
                           and all(isinstance(b, int) and b > 0 for b in v)),
     "remat": lambda v: isinstance(v, bool),
     "remat_policy": lambda v: v in ("full", "dots"),
-    "corr_impl": lambda v: v in ("gather", "onehot", "onehot_t", "pallas"),
+    "corr_impl": lambda v: v in ("gather", "onehot", "onehot_t", "softsel", "pallas"),
     "corr_dtype": lambda v: v in ("float32", "bfloat16"),
 }
 
@@ -202,7 +202,7 @@ def _build_parser(suppress=False):
     p.add_argument("--deadline-s", type=float, default=default(2400.0),
                    help="no new attempt starts after this wall-clock budget")
     p.add_argument("--corr-impl", default=default(None),
-                   choices=["gather", "onehot", "onehot_t", "pallas"],
+                   choices=["gather", "onehot", "onehot_t", "softsel", "pallas"],
                    help="override RAFTConfig.corr_impl")
     p.add_argument("--corr-dtype", default=default("bfloat16"),
                    choices=["float32", "bfloat16"],

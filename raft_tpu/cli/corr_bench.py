@@ -5,6 +5,9 @@ diff + wall-clock with explicit fences) applied to the corr-lookup backends:
 
 - ``gather``: flattened-index 4-corner take_along_axis (XLA)
 - ``onehot``: one-hot window GEMMs on the MXU (XLA)
+- ``softsel``: one-hot GEMMs with the bilinear lerp folded into the
+  selection matrices (no post-GEMM lerp chain)
+- ``onehot_t``: one-hot GEMMs over the transposed pixels-on-lanes pyramid
 - ``pallas``: block-pipelined mask-select kernel (TPU only; see
   ``kernels/corr_pallas.py`` for the design and its measured history)
 - ``alt``:    on-the-fly blockwise correlation (alt_cuda_corr analog, XLA)
@@ -54,8 +57,8 @@ def main(argv=None):
     p.add_argument("--levels", type=int, default=4)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--impls", nargs="+",
-                   default=["gather", "onehot", "onehot_t", "pallas", "alt",
-                            "alt_pallas"])
+                   default=["gather", "onehot", "onehot_t", "softsel", "pallas",
+                            "alt", "alt_pallas"])
     p.add_argument("--grad", action="store_true",
                    help="bench value+grad (the train-step cost) instead of "
                         "forward only")
@@ -73,7 +76,8 @@ def main(argv=None):
     from raft_tpu.models.corr import (alt_corr_lookup, build_corr_pyramid,
                                       build_corr_pyramid_t, corr_lookup,
                                       corr_lookup_onehot,
-                                      corr_lookup_onehot_t)
+                                      corr_lookup_onehot_t,
+                                      corr_lookup_softsel)
     from raft_tpu.ops.pooling import avg_pool2x2
 
     B, (H, W), C = args.batch, args.hw, args.dim
@@ -127,6 +131,9 @@ def main(argv=None):
                    lambda v, c: corr_lookup(v, c, args.radius), None),
         "onehot": (pyramid,
                    lambda v, c: corr_lookup_onehot(v, c, args.radius), None),
+        "softsel": (pyramid,
+                    lambda v, c: corr_lookup_softsel(v, c, args.radius),
+                    None),
         "onehot_t": (pyramid_t,
                      lambda v, c: corr_lookup_onehot_t(v, c, args.radius),
                      transpose_grads),

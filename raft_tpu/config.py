@@ -77,10 +77,10 @@ class RAFTConfig:
     remat_policy: str = "full"
 
     def __post_init__(self):
-        if self.corr_impl not in ("gather", "onehot", "onehot_t", "pallas"):
+        if self.corr_impl not in ("gather", "onehot", "onehot_t", "softsel", "pallas"):
             raise ValueError(
                 f"corr_impl={self.corr_impl!r}: choose gather, onehot, "
-                "onehot_t, or pallas (the memory-efficient alternate path "
+                "onehot_t, softsel, or pallas (the memory-efficient alternate path "
                 "is selected by alternate_corr=True, with corr_impl "
                 "picking its XLA/pallas backend)")
         if self.remat_policy not in ("full", "dots"):

@@ -196,6 +196,62 @@ def corr_lookup_onehot(pyramid: Sequence[jax.Array], coords: jax.Array,
     return jnp.concatenate(out, axis=-1).reshape(B, H, W, -1)
 
 
+def corr_lookup_softsel(pyramid: Sequence[jax.Array], coords: jax.Array,
+                        radius: int) -> jax.Array:
+    """One-hot lookup with the separable bilinear lerp FOLDED INTO the
+    selection matrices.
+
+    :func:`corr_lookup_onehot` selects an integer (2r+2)² window with 0/1
+    one-hots and then lerps neighboring rows/columns — and that post-GEMM
+    lerp chain runs on (B,N,P,P)/(B,N,P,Wl)-shaped tensors whose minor
+    dims tile the (8,128) memory tile at 8-31% occupancy (measured ~60
+    ms/step at chairs-b8, XProf session C). Here the selection matrices
+    are "soft two-hots" carrying the bilinear weights directly::
+
+        sel_y[b,n,k,h] = (1-wy)·[h == y0+k] + wy·[h == y0+k+1]
+
+    so the two GEMMs produce the final K×K window and no lerp
+    intermediates exist at all. Algebraically identical (separable
+    bilinear interpolation distributes over the contractions);
+    out-of-range taps still select nothing (zeros padding). With a bf16
+    volume the weights ride in the bf16 GEMM — one extra rounding of the
+    (exactly representable 0/1-range) fractional weights vs the onehot
+    path's fp32 lerp; the fp32 island keeps HIGHEST + fp32 selections.
+    """
+    B, H, W, _ = coords.shape
+    N = H * W
+    K = 2 * radius + 1
+    x = coords[..., 0].reshape(B, N).astype(jnp.float32)
+    y = coords[..., 1].reshape(B, N).astype(jnp.float32)
+
+    out = []
+    for i, vol in enumerate(pyramid):
+        Hl, Wl = vol.shape[-2:]
+        x0, y0, wx, wy = _window_base(x / (2 ** i), y / (2 ** i), radius)
+        taps = jnp.arange(K, dtype=jnp.int32)
+        rows = y0[..., None] + taps                      # (B, N, K)
+        cols = x0[..., None] + taps
+        fp32_vol = vol.dtype == jnp.float32
+        sel_dtype = jnp.float32 if fp32_vol else vol.dtype
+        prec = HIGHEST if fp32_vol else None
+        ih = jnp.arange(Hl)
+        iw = jnp.arange(Wl)
+        wy_ = wy[..., None, None]
+        wx_ = wx[..., None, None]
+        sel_y = ((1.0 - wy_) * (rows[..., None] == ih)
+                 + wy_ * (rows[..., None] + 1 == ih)).astype(sel_dtype)
+        sel_x = ((1.0 - wx_) * (cols[..., None] == iw)
+                 + wx_ * (cols[..., None] + 1 == iw)).astype(sel_dtype)
+        tmp = jnp.einsum("bnkh,bnhw->bnkw", sel_y, vol,
+                         precision=prec)                 # row select+lerp
+        win = jnp.einsum("bnkw,bnqw->bnkq", tmp, sel_x,
+                         precision=prec)                 # col select+lerp
+        # (B, N, Ky, Kx) -> x-major flat channels
+        out.append(jnp.swapaxes(win.astype(jnp.float32), -1, -2)
+                   .reshape(B, N, K * K))
+    return jnp.concatenate(out, axis=-1).reshape(B, H, W, -1)
+
+
 def build_corr_pyramid_t(fmap1: jax.Array, fmap2: jax.Array,
                          num_levels: int = 4) -> List[jax.Array]:
     """Transposed volume pyramid: levels of (B, Hl, Wl, N) — TARGET pixels

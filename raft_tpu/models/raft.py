@@ -34,6 +34,7 @@ from raft_tpu.models.corr import (
     corr_lookup,
     corr_lookup_onehot,
     corr_lookup_onehot_t,
+    corr_lookup_softsel,
 )
 from raft_tpu.models.encoders import BasicEncoder, SmallEncoder
 from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
@@ -139,8 +140,9 @@ class RAFT(nn.Module):
                     return corr_lookup_pallas(state, coords, cfg.corr_radius,
                                               prepadded=True)
             else:
-                lookup_fn = (corr_lookup_onehot if cfg.corr_impl == "onehot"
-                             else corr_lookup)
+                lookup_fn = {"onehot": corr_lookup_onehot,
+                             "softsel": corr_lookup_softsel,
+                             "gather": corr_lookup}[cfg.corr_impl]
 
                 def lookup(state, coords):
                     return lookup_fn(state, coords, cfg.corr_radius)

@@ -300,3 +300,63 @@ class TestOnehotTParity:
             outs[impl] = np.asarray(flow)
         np.testing.assert_allclose(outs["onehot_t"], outs["onehot"],
                                    atol=1e-4, rtol=1e-4)
+
+
+class TestSoftselParity:
+    """softsel folds the separable bilinear lerp into the selection
+    matrices — algebraically identical to the oracle, with no lerp
+    intermediates (they burned ~60 ms/step of tile-padded traffic)."""
+
+    def test_matches_gather(self, setup):
+        from raft_tpu.models.corr import corr_lookup_softsel
+
+        pyramid, coords = setup
+        want = np.asarray(corr_lookup(pyramid, coords, RADIUS))
+        got = np.asarray(corr_lookup_softsel(pyramid, coords, RADIUS))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_grad_matches_gather(self, setup):
+        from raft_tpu.models.corr import corr_lookup_softsel
+
+        pyramid, coords = setup
+        g_want = jax.grad(
+            lambda p: jnp.sum(corr_lookup(p, coords, RADIUS) ** 2)
+        )(list(pyramid))
+        g_got = jax.grad(
+            lambda p: jnp.sum(corr_lookup_softsel(p, coords, RADIUS) ** 2)
+        )(list(pyramid))
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_bf16_volume_close_to_onehot_bf16(self, setup):
+        """With a bf16 volume the weights ride the bf16 GEMM — one extra
+        rounding vs onehot's fp32 lerp. Pin that the extra drift stays in
+        the same class as the volume's own storage rounding."""
+        from raft_tpu.models.corr import corr_lookup_softsel
+
+        pyramid, coords = setup
+        pyr16 = [v.astype(jnp.bfloat16) for v in pyramid]
+        ref = np.asarray(corr_lookup_onehot(pyr16, coords, RADIUS))
+        got = np.asarray(corr_lookup_softsel(pyr16, coords, RADIUS))
+        scale = np.abs(ref).max()
+        assert np.abs(got - ref).max() / scale < 2e-2, (
+            np.abs(got - ref).max(), scale)
+
+    def test_model_forward_same_flow(self):
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.models import RAFT
+
+        rng = np.random.RandomState(5)
+        i1 = jnp.asarray(rng.rand(1, 32, 48, 3).astype(np.float32) * 255)
+        i2 = jnp.asarray(rng.rand(1, 32, 48, 3).astype(np.float32) * 255)
+        outs = {}
+        for impl in ("onehot", "softsel"):
+            cfg = RAFTConfig(small=True, corr_impl=impl)
+            variables = RAFT(cfg).init(jax.random.PRNGKey(0), i1, i2,
+                                       iters=1)
+            _, flow = RAFT(cfg).apply(variables, i1, i2, iters=3,
+                                      test_mode=True)
+            outs[impl] = np.asarray(flow)
+        np.testing.assert_allclose(outs["softsel"], outs["onehot"],
+                                   atol=1e-4, rtol=1e-4)

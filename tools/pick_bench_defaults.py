@@ -29,7 +29,7 @@ def flags_from_metric(metric: str):
     mc = re.search(r"_corr(bfloat16|float32)", metric)
     if mc:
         flags["corr_dtype"] = mc.group(1)
-    mi = re.search(r"_(gather|onehot_t|onehot|pallas)$", metric.replace(
+    mi = re.search(r"_(gather|onehot_t|onehot|softsel|pallas)$", metric.replace(
         "_corrbfloat16", "").replace("_corrfloat32", ""))
     if mi:
         flags["corr_impl"] = mi.group(1)
