@@ -53,10 +53,14 @@ def batch_sharding_2d(mesh):
 
 
 class TestShardingEquivalence:
-    def test_spatial_sharding_matches_single_device(self, rng):
+    @pytest.mark.parametrize("impl", ["onehot", "softsel", "onehot_t"])
+    def test_spatial_sharding_matches_single_device(self, rng, impl):
         """The (data x spatial) sharded train step must produce the same
         loss/metrics as an unsharded run — XLA's inserted collectives
-        (psum, halo exchanges) are an implementation detail, not semantics.
+        (psum, halo exchanges) are an implementation detail, not semantics
+        — for EVERY XLA lookup variant (onehot_t in particular reshapes
+        (B,H,W,*) into (...,H*W) layouts GSPMD must partition without
+        gathers).
 
         Images are 64x64 so each spatial shard holds 4 feature rows —
         the minimum extent XLA partitions correctly inside the scanned
@@ -68,7 +72,7 @@ class TestShardingEquivalence:
         from raft_tpu.training.train_step import (create_train_state,
                                                   make_train_step)
 
-        model_cfg = RAFTConfig(small=True)
+        model_cfg = RAFTConfig(small=True, corr_impl=impl)
         train_cfg = TrainConfig(stage="chairs", num_steps=10, batch_size=4,
                                 iters=2)
         batch_np = {
