@@ -58,10 +58,6 @@ bench_cfg() {
 # the in-model picture can differ — decide the default on THIS number)
 bench_cfg h_onehot_t_b8 1800 --batches 8 --corr-dtype bfloat16 --no-remat \
     --corr-impl onehot_t
-# the bf16 shootout row (swallowed twice by the worker crash)
-step t_bf16 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
-    --iters 20 --impls gather onehot onehot_t --grad --corr-dtype bfloat16
-step pick_defaults_e 120 python tools/pick_bench_defaults.py "$LADDER"
 # softsel lookup (bilinear lerp folded into the selection GEMMs — kills
 # the ~60 ms/step post-GEMM lerp chain): isolated + whole-step decision
 step s_grad 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
@@ -71,6 +67,12 @@ step s_bf16 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
 bench_cfg i_softsel_b8 1800 --batches 8 --corr-dtype bfloat16 --no-remat \
     --corr-impl softsel
 step pick_defaults_s 120 python tools/pick_bench_defaults.py "$LADDER"
+
+# the bf16 shootout row LAST among benches: twice its neighborhood saw the
+# worker crash; keep it from eating the window before the decision rows
+step t_bf16 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls gather onehot onehot_t --grad --corr-dtype bfloat16
+step pick_defaults_e 120 python tools/pick_bench_defaults.py "$LADDER"
 
 # clean trainer steps/s with the fixed logger accounting (the previous
 # resume-leg "5.01 steps/s" line was a resume-window artifact)
