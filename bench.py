@@ -73,14 +73,16 @@ def is_oom(exc: Exception) -> bool:
             or re.search(r"\boom\b", s) is not None)
 
 
-def build(batch_size, remat, overrides, image_hw=IMAGE_HW):
+def build(batch_size, remat, overrides, image_hw=IMAGE_HW,
+          fused_loss=False):
     from raft_tpu.config import RAFTConfig, stage_config
     from raft_tpu.training.train_step import (create_train_state,
                                               make_train_step)
 
     model_cfg = RAFTConfig(small=False, mixed_precision=True, remat=remat,
                            **overrides)
-    train_cfg = stage_config("chairs", batch_size=batch_size)
+    train_cfg = stage_config("chairs", batch_size=batch_size,
+                             fused_loss=fused_loss)
     rng = jax.random.PRNGKey(0)
     state = create_train_state(model_cfg, train_cfg, rng, image_hw=image_hw)
     step = jax.jit(make_train_step(model_cfg, train_cfg), donate_argnums=(0,))
@@ -99,12 +101,14 @@ def build(batch_size, remat, overrides, image_hw=IMAGE_HW):
     return state, step, batch, rng
 
 
-def run(batch_size, remat, warmup, steps, overrides, image_hw=IMAGE_HW):
+def run(batch_size, remat, warmup, steps, overrides, image_hw=IMAGE_HW,
+        fused_loss=False):
     from raft_tpu.utils.timing import force_train as force
     warmup, steps = max(1, warmup), max(1, steps)  # force() needs metrics
     log(f"building batch={batch_size} remat={remat} hw={image_hw} "
-        f"overrides={overrides}")
-    state, step, batch, rng = build(batch_size, remat, overrides, image_hw)
+        f"overrides={overrides} fused_loss={fused_loss}")
+    state, step, batch, rng = build(batch_size, remat, overrides, image_hw,
+                                    fused_loss)
     log("compiling + warmup")
     for _ in range(warmup):
         state, metrics = step(state, batch, rng)
@@ -139,6 +143,7 @@ _DEFAULTS_SCHEMA = {
     "remat_policy": lambda v: v in ("full", "dots"),
     "corr_impl": lambda v: v in ("gather", "onehot", "onehot_t", "softsel", "pallas"),
     "corr_dtype": lambda v: v in ("float32", "bfloat16"),
+    "fused_loss": lambda v: isinstance(v, bool),
 }
 
 
@@ -204,6 +209,11 @@ def _build_parser(suppress=False):
     p.add_argument("--corr-impl", default=default(None),
                    choices=["gather", "onehot", "onehot_t", "softsel", "pallas"],
                    help="override RAFTConfig.corr_impl")
+    p.add_argument("--fused-loss", action=argparse.BooleanOptionalAction,
+                   default=default(False),
+                   help="sequence loss in the upsampler's subpixel domain "
+                        "(TrainConfig.fused_loss): same values, no "
+                        "(T,B,8H,8W,2) stack materialization")
     p.add_argument("--corr-dtype", default=default("bfloat16"),
                    choices=["float32", "bfloat16"],
                    help="correlation-volume storage dtype. Default "
@@ -287,7 +297,8 @@ def main():
             overrides["remat_policy"] = args.remat_policy
         try:
             value = run(batch_size, args.remat, args.warmup, args.steps,
-                        overrides, tuple(args.hw))
+                        overrides, tuple(args.hw),
+                        fused_loss=args.fused_loss)
         except Exception as exc:
             last_err = exc
             if is_oom(exc):
@@ -302,6 +313,8 @@ def main():
             tag += f"_{args.corr_impl}"
         if args.corr_dtype:
             tag += f"_corr{args.corr_dtype}"
+        if args.fused_loss:
+            tag += "_fusedloss"
         emit(f"raft_basic_train_{shape_tag}_bf16_b{batch_size}"
              f"_iters{ITERS}_1chip{tag}", value)
         return 0

@@ -40,6 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="exponential weighting")
     p.add_argument("--add_noise", action="store_true")
     p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--fused_loss", action="store_true",
+                   help="sequence loss in the upsampler's subpixel domain "
+                        "(basic model): identical values, no full-res "
+                        "prediction-stack materialization")
     p.add_argument("--seed", type=int, default=1234)
     p.add_argument("--data_root", default="datasets")
     p.add_argument("--checkpoint_dir", default="checkpoints")
@@ -65,7 +69,7 @@ def configs_from_args(args) -> tuple[RAFTConfig, TrainConfig]:
         epsilon=args.epsilon, clip=args.clip, add_noise=args.add_noise,
         seed=args.seed, data_root=args.data_root,
         checkpoint_dir=args.checkpoint_dir, log_dir=args.log_dir,
-        num_workers=args.num_workers)
+        num_workers=args.num_workers, fused_loss=args.fused_loss)
     for k in ("lr", "num_steps", "batch_size", "wdecay", "gamma",
               "val_freq"):
         v = getattr(args, k)

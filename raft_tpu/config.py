@@ -168,6 +168,11 @@ class TrainConfig:
     # into log_dir — replaces the reference's manual cuda.synchronize
     # timing (SURVEY.md §5 tracing/profiling)
     profile_steps: Optional[Tuple[int, int]] = None
+    # compute the sequence loss in the convex upsampler's subpixel domain
+    # (basic model): identical values, but the (T,B,8H,8W,2) prediction
+    # stack and its cotangent never materialize — see
+    # training/loss.sequence_loss_subpixel
+    fused_loss: bool = False
 
 
 # Stage presets mirroring train_standard.sh:3-6 (2-GPU fp32 recipe).

@@ -40,6 +40,7 @@ from raft_tpu.models.encoders import BasicEncoder, SmallEncoder
 from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
 from raft_tpu.ops.flow_ops import (
     convex_upsample_batched,
+    convex_upsample_batched_raw,
     initialize_flow,
     upflow8_batched,
 )
@@ -70,11 +71,15 @@ class RAFT(nn.Module):
     def __call__(self, image1, image2, iters: int = 12,
                  flow_init: Optional[jax.Array] = None,
                  test_mode: bool = False, train: bool = False,
-                 freeze_bn: bool = False):
+                 freeze_bn: bool = False, raw_predictions: bool = False):
         """Estimate flow. Images: (B, H, W, 3) float in [0, 255], H, W % 8 == 0.
 
         Returns all per-iteration upsampled flows (iters, B, H, W, 2) in
-        train mode, or ``(flow_low, flow_up)`` in test mode.
+        train mode, or ``(flow_low, flow_up)`` in test mode. With
+        ``raw_predictions=True`` (basic model, train mode) the stack comes
+        back in the upsampler's subpixel domain (iters, B, 2, 64, H/8·W/8 —
+        see ops/flow_ops.convex_upsample_batched_raw) for the fused
+        sequence loss; the full-res stack never materializes.
         """
         cfg = self.config
         dt = cfg.compute_dtype
@@ -215,7 +220,12 @@ class RAFT(nn.Module):
             return flow_lr, flow_up
 
         if small:
+            assert not raw_predictions, (
+                "raw_predictions applies to the learned convex upsampler; "
+                "the small model upsamples bilinearly")
             flow_predictions = upflow8_batched(ys)
+        elif raw_predictions:
+            flow_predictions = convex_upsample_batched_raw(*ys)
         else:
             flow_predictions = convex_upsample_batched(*ys)
         return flow_predictions

@@ -85,11 +85,27 @@ def convex_upsample(flow: jax.Array, mask: jax.Array) -> jax.Array:
 
 
 def convex_upsample_batched(flow: jax.Array, mask: jax.Array) -> jax.Array:
+    """Convex 8x upsample of a STACK of iterations: standard layout out.
+
+    flow (T, B, H, W, 2) fp32, mask (T, B, H, W, 576) -> (T, B, 8H, 8W, 2).
+    """
+    T, B, H, W, _ = flow.shape
+    return subpixel_to_standard(
+        convex_upsample_batched_raw(flow, mask), H, W)
+
+
+def convex_upsample_batched_raw(flow: jax.Array,
+                                mask: jax.Array) -> jax.Array:
     """Convex 8x upsample of a STACK of iterations at once, tiled for TPU.
 
     flow (T, B, H, W, 2) fp32, mask (T, B, H, W, 576) any float dtype ->
-    (T, B, 8H, 8W, 2) fp32. Same math as :func:`convex_upsample` per frame
-    (softmax and combination in fp32), but laid out pixels-on-lanes.
+    (T, B, 2, 64, H*W) fp32 in the SUBPIXEL domain (s = 8i+j on dim 3,
+    n = W*h+w on dim 4); :func:`subpixel_to_standard` finishes the layout.
+    The raw form exists so the fused sequence loss can consume the stack
+    without ever materializing the (T,B,8H,8W,2) tensor (~560 MB fp32 at
+    chairs-b8) or its cotangent. Same math as :func:`convex_upsample` per
+    frame (softmax and combination in fp32), but laid out
+    pixels-on-lanes.
 
     Why this exists (measured, XProf r3 session C): inside the refinement
     scan the per-iteration formulation materializes (B,H,W,9,8,8) tensors
@@ -123,10 +139,27 @@ def convex_upsample_batched(flow: jax.Array, mask: jax.Array) -> jax.Array:
     # every operand/result are (64-multiple, HW) — lane-clean
     up = jnp.einsum("tbksn,tbckn->tbcsn", w9, nb,
                     precision=jax.lax.Precision.HIGHEST)
-    # (T,B,2,64,HW): s = 8i + j, n = W h + w  ->  (T,B,8H,8W,2)
+    return up  # (T, B, 2, 64, H*W); subpixel s = 8i + j, n = W*h + w
+
+
+def subpixel_to_standard(up: jax.Array, H: int, W: int) -> jax.Array:
+    """(T, B, 2, 64, H*W) subpixel-domain stack -> (T, B, 8H, 8W, 2)."""
+    T, B = up.shape[:2]
     up = up.reshape(T, B, 2, 8, 8, H, W)
     up = up.transpose(0, 1, 5, 3, 6, 4, 2)      # (t,b,h,i,w,j,c)
     return up.reshape(T, B, 8 * H, 8 * W, 2)
+
+
+def standard_to_subpixel(x: jax.Array) -> jax.Array:
+    """(B, 8H, 8W, C) -> (B, C, 64, H*W): the inverse image-side transform
+    of :func:`subpixel_to_standard`, for targets/masks that must meet the
+    upsampler's raw output in its own lane-tiled domain (fused loss). A
+    trailing scalar field can be passed as (B, 8H, 8W, 1)."""
+    B, H8, W8, C = x.shape
+    H, W = H8 // 8, W8 // 8
+    x = x.reshape(B, H, 8, W, 8, C)             # (b,h,i,w,j,c)
+    x = x.transpose(0, 5, 2, 4, 1, 3)           # (b,c,i,j,h,w)
+    return x.reshape(B, C, 64, H * W)
 
 
 def upflow8_batched(flow: jax.Array) -> jax.Array:

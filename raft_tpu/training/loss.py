@@ -8,6 +8,35 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.config import MAX_FLOW
+from raft_tpu.ops.flow_ops import standard_to_subpixel
+
+
+def _gamma_weighted_masked_l1(flow_preds, gt, vmask, gamma):
+    """sum_i gamma^(T-1-i) * mean(vmask * |pred_i - gt|) — the mean runs
+    over ALL elements, not just valid ones, matching train.py:58-60."""
+    T = flow_preds.shape[0]
+    i = jnp.arange(T, dtype=jnp.float32)
+    weights = gamma ** (T - 1 - i)                     # (T,)
+    l1 = jnp.abs(flow_preds - gt[None])
+    per_iter = (vmask * l1).mean(axis=tuple(range(1, l1.ndim)))
+    return jnp.sum(weights * per_iter)
+
+
+def _final_pred_metrics(epe, valid):
+    """epe/1px/3px/5px over valid pixels of the final prediction
+    (train.py:62-70). ``epe`` and ``valid`` share one shape."""
+    vf = valid.astype(jnp.float32)
+    count = jnp.maximum(vf.sum(), 1.0)
+
+    def vmean(x):
+        return (x * vf).sum() / count
+
+    return {
+        "epe": vmean(epe),
+        "1px": vmean((epe < 1).astype(jnp.float32)),
+        "3px": vmean((epe < 3).astype(jnp.float32)),
+        "5px": vmean((epe < 5).astype(jnp.float32)),
+    }
 
 
 def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
@@ -25,30 +54,35 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
     the masked L1 is averaged over ALL elements, not just valid ones
     (``(valid[:, None] * i_loss).mean()``, train.py:60).
     """
-    T = flow_preds.shape[0]
     mag = jnp.sqrt(jnp.sum(flow_gt ** 2, axis=-1))
     valid = (valid >= 0.5) & (mag < max_flow)          # (B, H, W)
     vmask = valid[None, ..., None].astype(jnp.float32)  # (1, B, H, W, 1)
 
-    i = jnp.arange(T, dtype=jnp.float32)
-    weights = gamma ** (T - 1 - i)                     # (T,)
-
-    l1 = jnp.abs(flow_preds - flow_gt[None])           # (T, B, H, W, 2)
-    per_iter = (vmask * l1).mean(axis=(1, 2, 3, 4))    # (T,)
-    flow_loss = jnp.sum(weights * per_iter)
-
-    # metrics on the final prediction, valid pixels only (train.py:62-70)
+    flow_loss = _gamma_weighted_masked_l1(flow_preds, flow_gt, vmask, gamma)
     epe = jnp.sqrt(jnp.sum((flow_preds[-1] - flow_gt) ** 2, axis=-1))
-    vf = valid.astype(jnp.float32)
-    count = jnp.maximum(vf.sum(), 1.0)
+    return flow_loss, _final_pred_metrics(epe, valid)
 
-    def vmean(x):
-        return (x * vf).sum() / count
 
-    metrics = {
-        "epe": vmean(epe),
-        "1px": vmean((epe < 1).astype(jnp.float32)),
-        "3px": vmean((epe < 3).astype(jnp.float32)),
-        "5px": vmean((epe < 5).astype(jnp.float32)),
-    }
-    return flow_loss, metrics
+def sequence_loss_subpixel(up_raw: jax.Array, flow_gt: jax.Array,
+                           valid: jax.Array, gamma: float = 0.8,
+                           max_flow: float = MAX_FLOW
+                           ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """:func:`sequence_loss` computed in the upsampler's subpixel domain.
+
+    up_raw: (T, B, 2, 64, H*W) — ``convex_upsample_batched_raw`` output.
+    flow_gt (B, 8H, 8W, 2), valid (B, 8H, 8W) are transformed ONCE into
+    the same layout; every reduction is over full element sets (or
+    valid-masked sums), so the values are identical to the standard
+    path while the (T,B,8H,8W,2) prediction stack — ~560 MB fp32 at
+    chairs-b8 — and its cotangent never materialize.
+    """
+    gt_t = standard_to_subpixel(flow_gt)               # (B, 2, 64, HW)
+    valid_t = standard_to_subpixel(valid[..., None])[:, 0]  # (B, 64, HW)
+
+    mag = jnp.sqrt(jnp.sum(gt_t ** 2, axis=1))         # (B, 64, HW)
+    valid_t = (valid_t >= 0.5) & (mag < max_flow)
+    vmask = valid_t[None, :, None].astype(jnp.float32)  # (1, B, 1, 64, HW)
+
+    flow_loss = _gamma_weighted_masked_l1(up_raw, gt_t, vmask, gamma)
+    epe = jnp.sqrt(jnp.sum((up_raw[-1] - gt_t) ** 2, axis=1))
+    return flow_loss, _final_pred_metrics(epe, valid_t)

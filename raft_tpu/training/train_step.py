@@ -21,7 +21,7 @@ from flax import struct
 
 from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.models import RAFT
-from raft_tpu.training.loss import sequence_loss
+from raft_tpu.training.loss import sequence_loss, sequence_loss_subpixel
 from raft_tpu.training.optim import make_optimizer
 
 
@@ -77,6 +77,11 @@ def make_train_step(model_cfg: RAFTConfig, train_cfg: TrainConfig):
     freeze_bn = train_cfg.stage != "chairs"  # train.py:147-148
     has_bn = (not model_cfg.small)
     mutable = ["batch_stats"] if (has_bn and not freeze_bn) else []
+    # fused loss: predictions stay in the upsampler's subpixel domain and
+    # the loss meets them there — the (T,B,8H,8W,2) stack (~560 MB fp32 at
+    # chairs-b8) and its cotangent never materialize. Identical values
+    # (pinned in tests/test_loss_optim.py); basic model only.
+    fused = train_cfg.fused_loss and not model_cfg.small
 
     def train_step(state: RAFTTrainState, batch: Dict[str, jax.Array],
                    rng: jax.Array):
@@ -101,14 +106,16 @@ def make_train_step(model_cfg: RAFTConfig, train_cfg: TrainConfig):
                 kwargs["mutable"] = mutable
             out = model.apply(
                 variables, image1, image2, iters=train_cfg.iters,
-                train=True, freeze_bn=freeze_bn, **kwargs,
+                train=True, freeze_bn=freeze_bn, raw_predictions=fused,
+                **kwargs,
             )
             if mutable:
                 preds, updated = out
                 new_bs = updated["batch_stats"]
             else:
                 preds, new_bs = out, state.batch_stats
-            loss, metrics = sequence_loss(
+            loss_impl = sequence_loss_subpixel if fused else sequence_loss
+            loss, metrics = loss_impl(
                 preds, batch["flow"], batch["valid"], train_cfg.gamma)
             return loss, (metrics, new_bs)
 

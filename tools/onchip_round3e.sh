@@ -66,6 +66,10 @@ step s_bf16 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
     --iters 20 --impls onehot softsel --grad --corr-dtype bfloat16
 bench_cfg i_softsel_b8 1800 --batches 8 --corr-dtype bfloat16 --no-remat \
     --corr-impl softsel
+# fused subpixel-domain loss: frees the 560 MB prediction stack + its
+# cotangent — try batch 10 FIRST (the stack was part of why b10 OOM'd)
+bench_cfg j_fused 2400 --batches 10 8 --corr-dtype bfloat16 --no-remat \
+    --fused-loss
 step pick_defaults_s 120 python tools/pick_bench_defaults.py "$LADDER"
 
 # the bf16 shootout row LAST among benches: twice its neighborhood saw the

@@ -29,8 +29,11 @@ def flags_from_metric(metric: str):
     mc = re.search(r"_corr(bfloat16|float32)", metric)
     if mc:
         flags["corr_dtype"] = mc.group(1)
+    if "_fusedloss" in metric:
+        flags["fused_loss"] = True
     mi = re.search(r"_(gather|onehot_t|onehot|softsel|pallas)$", metric.replace(
-        "_corrbfloat16", "").replace("_corrfloat32", ""))
+        "_corrbfloat16", "").replace("_corrfloat32", "").replace(
+        "_fusedloss", ""))
     if mi:
         flags["corr_impl"] = mi.group(1)
     return flags
