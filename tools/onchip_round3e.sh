@@ -85,6 +85,12 @@ step train_rate 1800 python -m raft_tpu.cli.train --name r3rate \
     --val_freq 1000 --batch_size 8 --num_workers 4 \
     --checkpoint_dir /root/.cache/raft_tpu/r3_rate --log_dir runs
 
+# serving re-measure: the session-C rows predate the test-mode rework
+# (mask rides the scan carry; only the final iteration upsamples)
+step infer_bf16_v2 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024 \
+    --corr_dtype bfloat16
+step infer_fp32_v2 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024
+
 log "round3e complete"
 cp "$OUT" /root/repo/ONCHIP_r03e.log 2>/dev/null || true
 for f in ONCHIP_r03e.log BENCH_DEFAULTS.json; do
