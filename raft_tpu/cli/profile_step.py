@@ -38,6 +38,10 @@ def main(argv=None):
     # the warmup loop's metrics; clamped below
     add_corr_args(p)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--fused_loss", "--fused-loss", action="store_true",
+                   help="trace the fused subpixel-domain loss path "
+                        "(TrainConfig.fused_loss) so the profile matches "
+                        "a fused-default bench config")
     p.add_argument("--fp32", action="store_true",
                    help="disable bf16 mixed precision")
     p.add_argument("--trace-dir", default=None,
@@ -55,13 +59,14 @@ def main(argv=None):
     model_cfg = RAFTConfig(small=False, mixed_precision=not args.fp32,
                            remat=args.remat, **overrides)
     train_cfg = stage_config("chairs", batch_size=args.batch,
-                             iters=args.iters)
+                             iters=args.iters,
+                             fused_loss=args.fused_loss)
 
     h, w = args.hw
     rng = jax.random.PRNGKey(0)
     print(f"backend={jax.default_backend()} batch={args.batch} hw={h}x{w} "
           f"iters={args.iters} bf16={not args.fp32} remat={args.remat} "
-          f"corr_impl={model_cfg.corr_impl}")
+          f"corr_impl={model_cfg.corr_impl} fused_loss={args.fused_loss}")
     t0 = time.perf_counter()
     state = create_train_state(model_cfg, train_cfg, rng, image_hw=(h, w))
     step = jax.jit(make_train_step(model_cfg, train_cfg),

@@ -77,6 +77,14 @@ class Logger:
         flushing at the same ``total_steps % sum_freq == sum_freq - 1``
         boundaries as :meth:`push` so records/labels stay step-aligned
         with the reference logger (train.py:97-103).
+
+        Intentional divergence from :meth:`push` (ADVICE r3): the mean
+        divides by the ACTUAL sample count ``n``. ``push`` mirrors the
+        reference bug-for-bug and divides the first window (which holds
+        only ``sum_freq - 1`` samples) by ``sum_freq``, understating its
+        means by ~1/sum_freq; this path reports the true mean instead.
+        Every later window holds exactly ``sum_freq`` samples, where the
+        two paths agree.
         """
         if n <= 0:
             return

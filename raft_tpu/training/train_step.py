@@ -12,6 +12,7 @@ gradient ``psum`` and conv halo exchanges. No hand-written collectives.
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -82,6 +83,12 @@ def make_train_step(model_cfg: RAFTConfig, train_cfg: TrainConfig):
     # chairs-b8) and its cotangent never materialize. Identical values
     # (pinned in tests/test_loss_optim.py); basic model only.
     fused = train_cfg.fused_loss and not model_cfg.small
+    if train_cfg.fused_loss and model_cfg.small:
+        warnings.warn(
+            "fused_loss requested with the small model, which has no "
+            "fused path (its upsampling is a plain 8x interpolate, not "
+            "the learned convex mask the fusion rides on) — falling "
+            "back to the standard sequence loss", stacklevel=2)
 
     def train_step(state: RAFTTrainState, batch: Dict[str, jax.Array],
                    rng: jax.Array):

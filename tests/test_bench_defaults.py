@@ -109,3 +109,45 @@ class TestApplyMeasuredDefaults:
             {"batches": [8], "remat_policy": "dot"}))
         args = self._merge(bench, [])
         assert args.batches == [8, 6, 4, 2] and args.remat_policy is None
+
+
+class TestWorkerCrashClassifier:
+    """Round-4 hardening: a transient tunnel-worker death gets one bounded
+    retry instead of zeroing the driver bench (BENCH_r01..r03 all 0.0)."""
+
+    def test_crash_messages_detected(self, modules):
+        bench, _ = modules
+        for msg in (
+            "UNAVAILABLE: TPU worker process crashed or restarted",
+            "FAILED_PRECONDITION: worker process restarted mid-call",
+            "Unavailable: socket closed before response",
+            "UNAVAILABLE: connection reset by peer",
+        ):
+            assert bench.is_worker_crash(RuntimeError(msg)), msg
+
+    def test_non_crash_errors_not_detected(self, modules):
+        bench, _ = modules
+        for msg in (
+            "RESOURCE_EXHAUSTED: out of memory allocating 2.1GiB",
+            "INVALID_ARGUMENT: shapes must be equal",
+            "Ran out of memory in memory space vmem",
+            "some unrelated ValueError",
+        ):
+            assert not bench.is_worker_crash(RuntimeError(msg)), msg
+
+    def test_crash_is_not_oom(self, modules):
+        # the two classifiers must be disjoint: a crash must never trigger
+        # the try-smaller-batch ladder, and an OOM must never re-exec
+        bench, _ = modules
+        crash = RuntimeError("UNAVAILABLE: TPU worker process crashed")
+        oom = RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        assert bench.is_worker_crash(crash) and not bench.is_oom(crash)
+        assert bench.is_oom(oom) and not bench.is_worker_crash(oom)
+
+
+class TestFallbackBatches:
+    def test_winner_keeps_smaller_rungs(self, modules):
+        _, pick = modules
+        assert pick.with_fallbacks([10]) == [10, 8, 6, 4, 2]
+        assert pick.with_fallbacks([8]) == [8, 6, 4, 2]
+        assert pick.with_fallbacks([2]) == [2]

@@ -1,0 +1,77 @@
+#!/bin/bash
+# Bisect the round-3 "bf16 shootout" TPU-worker crash (VERDICT r3 weak #3).
+#
+# Evidence re-read (ONCHIP_r03c.log:14-21): t_grad's process exited at
+# 05:23:17 and t_bf16 STARTED the same second; inside t_bf16 ALL THREE
+# impls — including onehot, which had just run clean at fp32 40 s
+# earlier — failed with the identical "TPU worker process crashed or
+# restarted" message. corr_bench runs impls back-to-back in one process,
+# so a worker that was already dead when the process started fails all
+# three without any impl ever executing on chip.
+#
+# Hypothesis A (primary): the crash is the known CRASH-ON-EXIT mode (the
+#   worker dies right after the previous client exits; memory:
+#   axon-tunnel-ops) — nothing bf16-specific ever ran.
+# Hypothesis B: a genuine bf16-input kernel fault in one impl's grad.
+#
+# Protocol: for each cell, WAIT for a healthy probe, run the cell in a
+# fresh process, record rc, wait 20 s, then probe again to see whether
+# the worker survived the cell's exit. healthy->pass->healthy for every
+# cell confirms A (fence: probe-before-run, already in the runbooks);
+# a reproducible in-cell failure after a healthy pre-probe pins B to
+# that exact impl x dtype x grad cell.
+set -u
+cd /root/repo
+OUT=${1:-/tmp/crash_bisect.out}
+MARK=/root/.cache/raft_tpu/r4_markers
+mkdir -p "$MARK"
+log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
+probe() {
+    timeout -k 10 120 python -c \
+        "import jax; assert jax.devices()[0].platform != 'cpu'" \
+        >/dev/null 2>&1
+}
+wait_chip() {
+    for _ in 1 2 3 4 5 6 7 8; do
+        probe && return 0
+        log "chip not answering; waiting 60s"
+        sleep 60
+    done
+    return 1
+}
+cell() {
+    local name=$1; shift
+    if [ -e "$MARK/bisect_$name" ]; then log "skip $name (done)"; return 0; fi
+    wait_chip || { log "SKIP $name (chip unavailable)"; return 1; }
+    log "begin $name: $*"
+    if timeout 900 "$@" >> "$OUT" 2>&1; then
+        log "cell $name rc=0"
+    else
+        log "cell $name rc=$?"
+    fi
+    sleep 20  # crash-on-exit takes a moment to manifest on the next call
+    if probe; then
+        log "post-$name probe: worker ALIVE"
+    else
+        log "post-$name probe: worker DEAD (crash-on-exit reproduced)"
+    fi
+    touch "$MARK/bisect_$name"
+    cp "$OUT" /root/repo/CRASH_BISECT_r04.log 2>/dev/null || true
+}
+
+CB="python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 --iters 20"
+# the failing row's cells, one impl per fresh process
+cell gather_bf16_grad   $CB --impls gather   --grad --corr-dtype bfloat16
+cell onehot_bf16_grad   $CB --impls onehot   --grad --corr-dtype bfloat16
+cell onehot_t_bf16_grad $CB --impls onehot_t --grad --corr-dtype bfloat16
+cell softsel_bf16_grad  $CB --impls softsel  --grad --corr-dtype bfloat16
+# controls: fp32 grad passed in r3; bf16 fwd-only isolates grad-ness
+cell onehot_fp32_grad_ctl $CB --impls onehot --grad
+cell gather_bf16_fwd      $CB --impls gather --corr-dtype bfloat16
+# the original three-impl single-process row, now AFTER a guaranteed
+# healthy probe — if it passes here, hypothesis A is confirmed
+cell original_row $CB --impls gather onehot onehot_t --grad \
+    --corr-dtype bfloat16
+
+log "bisect complete"
+cp "$OUT" /root/repo/CRASH_BISECT_r04.log 2>/dev/null || true

@@ -1,0 +1,71 @@
+"""Fabricate a minimal staged-data layout for real_data_accept.sh --selftest.
+
+Writes exactly the directory shapes the acceptance script expects the
+operator to stage (Sintel per ``datasets.py`` MpiSintel's scene globs,
+FlyingChairs per its ``*.ppm``/``*.flo`` + split-file contract, reference
+``evaluate.py:75,96`` context) so the acceptance pipeline is provable
+TODAY, end to end, without the real data: staging day becomes execution,
+not development.
+"""
+
+from __future__ import annotations
+
+import os
+import os.path as osp
+import sys
+
+import numpy as np
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+from PIL import Image  # noqa: E402
+
+from raft_tpu.data.frame_utils import write_flow  # noqa: E402
+
+
+def _img(rng, h, w):
+    return rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+
+
+def fabricate(root: str, h: int = 128, w: int = 256) -> None:
+    """Sintel frames at (h, w) — eval pads, so small+fast is fine; Chairs
+    frames at the dataset's real 384x512, which the chairs-stage 368x496
+    training crop (train_standard.sh) must fit inside."""
+    rng = np.random.RandomState(0)
+
+    # --- Sintel: training/{clean,final,flow}/<scene>/frame_%04d ---------
+    for scene in ("alley_1", "temple_2"):
+        for dstype in ("clean", "final"):
+            d = osp.join(root, "Sintel", "training", dstype, scene)
+            os.makedirs(d, exist_ok=True)
+            for i in range(3):
+                Image.fromarray(_img(rng, h, w)).save(
+                    osp.join(d, f"frame_{i + 1:04d}.png"))
+        d = osp.join(root, "Sintel", "training", "flow", scene)
+        os.makedirs(d, exist_ok=True)
+        for i in range(2):  # one flow per consecutive pair
+            write_flow(osp.join(d, f"frame_{i + 1:04d}.flo"),
+                       rng.randn(h, w, 2).astype(np.float32))
+
+    # --- FlyingChairs: data/%05d_img{1,2}.ppm + %05d_flow.flo -----------
+    d = osp.join(root, "FlyingChairs_release", "data")
+    os.makedirs(d, exist_ok=True)
+    n = 8
+    ch, cw = 384, 512  # real FlyingChairs frame size
+    for i in range(1, n + 1):
+        Image.fromarray(_img(rng, ch, cw)).save(
+            osp.join(d, f"{i:05d}_img1.ppm"))
+        Image.fromarray(_img(rng, ch, cw)).save(
+            osp.join(d, f"{i:05d}_img2.ppm"))
+        write_flow(osp.join(d, f"{i:05d}_flow.flo"),
+                   rng.randn(ch, cw, 2).astype(np.float32))
+    # split file: mark most pairs train(1), last two validation(2)
+    with open(osp.join(root, "FlyingChairs_release",
+                       "chairs_split.txt"), "w") as f:
+        for i in range(1, n + 1):
+            f.write(f"{1 if i <= n - 2 else 2}\n")
+    print(f"fabricated selftest layout under {root}")
+
+
+if __name__ == "__main__":
+    fabricate(sys.argv[1] if len(sys.argv) > 1 else "/tmp/raft_accept_data")

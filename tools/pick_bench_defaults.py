@@ -39,6 +39,13 @@ def flags_from_metric(metric: str):
     return flags
 
 
+def with_fallbacks(batches):
+    """Measured batch first, then smaller rungs: a driver-time OOM at the
+    winner (e.g. HBM fragmentation) must degrade bench.py to a slower
+    number, not to 0.0."""
+    return batches + [b for b in (8, 6, 4, 2) if b < batches[0]]
+
+
 def main():
     ladder_dir = sys.argv[1]
     best = None
@@ -68,6 +75,7 @@ def main():
         print(f"could not parse flags from metric {rec['metric']!r}")
         return 1
     out = dict(flags)
+    out["batches"] = with_fallbacks(out["batches"])
     out["_measured"] = {"metric": rec["metric"], "value": rec["value"],
                         "ladder_file": name}
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

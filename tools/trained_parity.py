@@ -35,11 +35,23 @@ def torch_flow_cached(pth, img1, img2, small, iters, cache_dir):
     bit-deterministic for a given (checkpoint, crop, iters) — rerunning
     the tool (e.g. after TPU-side changes) should not repay it."""
     st = os.stat(pth)  # fingerprint: same-named but replaced ckpt files
-    #                    must not reuse a stale cached reference flow
-    key = (f"torchflow_{osp.basename(pth)}_{st.st_size}_{int(st.st_mtime)}"
+    #                    must not reuse a stale cached reference flow;
+    #                    st_mtime_ns (not integer seconds) so a same-size
+    #                    replacement within one second still misses
+    key = (f"torchflow_{osp.basename(pth)}_{st.st_size}_{st.st_mtime_ns}"
            f"_{iters}_{img1.shape[0]}x{img1.shape[1]}.npy")
-    path = osp.join(cache_dir, key)
+    subdir = osp.join(cache_dir, "torchflow_cache")  # don't litter ckpt_dir
+    os.makedirs(subdir, exist_ok=True)
+    path = osp.join(subdir, key)
     if osp.exists(path):
+        return np.load(path)
+    # migrate a round-3 cache hit (legacy key: integer-second mtime, flat
+    # in cache_dir) instead of re-paying minutes of torch forwards
+    legacy = osp.join(cache_dir, (
+        f"torchflow_{osp.basename(pth)}_{st.st_size}_{int(st.st_mtime)}"
+        f"_{iters}_{img1.shape[0]}x{img1.shape[1]}.npy"))
+    if osp.exists(legacy):
+        os.replace(legacy, path)
         return np.load(path)
     out = torch_flow(pth, img1, img2, small, iters)
     np.save(path, out)
