@@ -10,10 +10,9 @@ import sys
 import pytest
 
 
-@pytest.fixture(scope="module")
-def modules():
+def load_bench(name="bench_mod"):
     spec = importlib.util.spec_from_file_location(
-        "bench_mod", "/root/repo/bench.py")
+        name, "/root/repo/bench.py")
     bench = importlib.util.module_from_spec(spec)
     saved = sys.argv
     sys.argv = ["bench.py"]
@@ -21,6 +20,12 @@ def modules():
         spec.loader.exec_module(bench)
     finally:
         sys.argv = saved
+    return bench
+
+
+@pytest.fixture(scope="module")
+def modules():
+    bench = load_bench()
     spec2 = importlib.util.spec_from_file_location(
         "pick_mod", "/root/repo/tools/pick_bench_defaults.py")
     pick = importlib.util.module_from_spec(spec2)
@@ -151,3 +156,20 @@ class TestFallbackBatches:
         assert pick.with_fallbacks([10]) == [10, 8, 6, 4, 2]
         assert pick.with_fallbacks([8]) == [8, 6, 4, 2]
         assert pick.with_fallbacks([2]) == [2]
+
+
+class TestDeadlineCarryover:
+    def test_start_shifts_back_by_elapsed_env(self, monkeypatch):
+        # the crash-retry re-exec hands its elapsed seconds to the fresh
+        # process via RAFT_BENCH_ELAPSED; START must move back by that
+        # much so --deadline-s bounds TOTAL wall-clock, not per-process
+        import time as _time
+
+        monkeypatch.setenv("RAFT_BENCH_ELAPSED", "1234.5")
+        t0 = _time.monotonic()
+        mod = load_bench("bench_elapsed_mod")
+        t1 = _time.monotonic()
+        # START was stamped between t0 and t1, shifted back 1234.5 s —
+        # bound it from both sides with no hidden import-time budget
+        assert t0 - mod.START <= 1234.5 + 1e-3
+        assert t1 - mod.START >= 1234.5

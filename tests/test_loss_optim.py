@@ -179,3 +179,26 @@ class TestSequenceLossSubpixel:
         for k in outs[False]:
             np.testing.assert_allclose(outs[True][k], outs[False][k],
                                        rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+def test_fused_loss_with_small_model_warns_and_falls_back():
+    """--fused_loss with the small model has no fused path (ADVICE r3):
+    the builder must say so instead of silently using the standard loss."""
+    import warnings as _warnings
+
+    from raft_tpu.config import RAFTConfig, stage_config
+    from raft_tpu.training.train_step import make_train_step
+
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        make_train_step(RAFTConfig(small=True),
+                        stage_config("chairs", batch_size=1,
+                                     fused_loss=True))
+    assert any("fused_loss" in str(w.message) for w in caught)
+
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        make_train_step(RAFTConfig(small=False),
+                        stage_config("chairs", batch_size=1,
+                                     fused_loss=True))
+    assert not any("fused_loss" in str(w.message) for w in caught)
