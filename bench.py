@@ -161,6 +161,8 @@ _DEFAULTS_SCHEMA = {
     "corr_impl": lambda v: v in ("gather", "onehot", "onehot_t", "softsel", "pallas"),
     "corr_dtype": lambda v: v in ("float32", "bfloat16"),
     "fused_loss": lambda v: isinstance(v, bool),
+    "scan_unroll": lambda v: (isinstance(v, int)
+                              and not isinstance(v, bool) and v >= 1),
 }
 
 
@@ -231,6 +233,10 @@ def _build_parser(suppress=False):
                    help="sequence loss in the upsampler's subpixel domain "
                         "(TrainConfig.fused_loss): same values, no "
                         "(T,B,8H,8W,2) stack materialization")
+    p.add_argument("--scan-unroll", type=int, default=default(1),
+                   help="lax.scan unroll factor for the refinement loop "
+                        "(RAFTConfig.scan_unroll); >1 lets XLA pipeline "
+                        "across iteration boundaries")
     p.add_argument("--corr-dtype", default=default("bfloat16"),
                    choices=["float32", "bfloat16"],
                    help="correlation-volume storage dtype. Default "
@@ -255,6 +261,9 @@ def main():
     if args.hw[0] % 8 or args.hw[1] % 8:
         p.error(f"--hw {args.hw[0]} {args.hw[1]}: both must be divisible "
                 "by 8 (catch it here, not after a multi-minute compile)")
+    if args.scan_unroll < 1:
+        p.error(f"--scan-unroll {args.scan_unroll}: must be >= 1 (catch "
+                "it here, not after the backend probe)")
     h, w = args.hw
     stage = "chairs_" if (h, w) == IMAGE_HW else ""
     shape_tag = f"{stage}{h}x{w}"
@@ -332,6 +341,8 @@ def main():
             overrides["corr_dtype"] = args.corr_dtype
         if args.remat_policy:
             overrides["remat_policy"] = args.remat_policy
+        if args.scan_unroll != 1:
+            overrides["scan_unroll"] = args.scan_unroll
         try:
             value = run(batch_size, args.remat, args.warmup, args.steps,
                         overrides, tuple(args.hw),
@@ -366,6 +377,8 @@ def main():
             tag += f"_corr{args.corr_dtype}"
         if args.fused_loss:
             tag += "_fusedloss"
+        if args.scan_unroll != 1:
+            tag += f"_unroll{args.scan_unroll}"
         emit(f"raft_basic_train_{shape_tag}_bf16_b{batch_size}"
              f"_iters{ITERS}_1chip{tag}", value)
         return 0

@@ -82,8 +82,21 @@ class RAFTConfig:
     # the memory win at a fraction of the recompute, since the body is
     # conv/GEMM-dominated
     remat_policy: str = "full"
+    # lax.scan unroll factor for the refinement loop: >1 replicates the
+    # iteration body so XLA can software-pipeline across iteration
+    # boundaries (overlap iteration i's GRU convs with i+1's lookup
+    # GEMMs) at the cost of unroll x compile time and code size. Math is
+    # identical for any value (pinned in tests/test_model.py). No
+    # hardware number as of r4 — ladder row queued in
+    # tools/onchip_round4.sh.
+    scan_unroll: int = 1
 
     def __post_init__(self):
+        if not (isinstance(self.scan_unroll, int)
+                and not isinstance(self.scan_unroll, bool)
+                and self.scan_unroll >= 1):
+            raise ValueError(
+                f"scan_unroll={self.scan_unroll!r}: must be an int >= 1")
         if self.corr_impl not in ("gather", "onehot", "onehot_t", "softsel", "pallas"):
             raise ValueError(
                 f"corr_impl={self.corr_impl!r}: choose gather, onehot, "

@@ -201,6 +201,7 @@ class RAFT(nn.Module):
             in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=iters,
+            unroll=cfg.scan_unroll,
         )
         init_carry = (net, coords1)
         if test_mode and not small:

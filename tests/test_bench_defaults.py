@@ -173,3 +173,30 @@ class TestDeadlineCarryover:
         # bound it from both sides with no hidden import-time budget
         assert t0 - mod.START <= 1234.5 + 1e-3
         assert t1 - mod.START >= 1234.5
+
+
+class TestScanUnrollPlumbing:
+    def test_metric_tag_roundtrip(self, modules):
+        _, pick = modules
+        f = pick.flags_from_metric
+        assert f("raft_basic_train_chairs_368x496_bf16_b8_iters12_1chip"
+                 "_corrbfloat16_unroll2") == {
+            "batches": [8], "corr_dtype": "bfloat16", "scan_unroll": 2}
+        # the unroll tag must not break the trailing corr_impl match
+        assert f("raft_basic_train_chairs_368x496_bf16_b8_iters12_1chip"
+                 "_softsel_corrbfloat16_unroll4") == {
+            "batches": [8], "corr_dtype": "bfloat16", "scan_unroll": 4,
+            "corr_impl": "softsel"}
+
+    def test_defaults_schema_accepts_unroll(self, modules):
+        bench, _ = modules
+        assert bench._DEFAULTS_SCHEMA["scan_unroll"](2)
+        assert not bench._DEFAULTS_SCHEMA["scan_unroll"](0)
+        assert not bench._DEFAULTS_SCHEMA["scan_unroll"]("2")
+
+    def test_defaults_schema_rejects_bool(self, modules):
+        # isinstance(True, int) is True: a copy-pasted JSON `true` must
+        # fail the schema, not silently measure unroll=1 behind an
+        # "applied" log line
+        bench, _ = modules
+        assert not bench._DEFAULTS_SCHEMA["scan_unroll"](True)

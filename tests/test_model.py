@@ -170,3 +170,26 @@ class TestBatchNormModes:
                              frozen["batch_stats"],
                              variables["batch_stats"])
         assert max(jax.tree.leaves(diffs)) == 0
+
+
+class TestScanUnroll:
+    def test_unroll_is_math_identical(self, small_model):
+        """RAFTConfig.scan_unroll only changes XLA scheduling (body
+        replication for cross-iteration pipelining) — predictions must be
+        identical to the rolled scan for the same params."""
+        model, variables = small_model
+        rng = np.random.RandomState(0)
+        img1 = jnp.asarray(rng.rand(1, 32, 32, 3) * 255.0, jnp.float32)
+        img2 = jnp.asarray(rng.rand(1, 32, 32, 3) * 255.0, jnp.float32)
+        base = model.apply(variables, img1, img2, iters=5)
+        for unroll in (2, 5):
+            m = RAFT(RAFTConfig(small=True, scan_unroll=unroll))
+            out = m.apply(variables, img1, img2, iters=5)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_unroll_validation(self):
+        with pytest.raises(ValueError):
+            RAFTConfig(scan_unroll=0)
+        with pytest.raises(ValueError):
+            RAFTConfig(scan_unroll=1.5)

@@ -125,6 +125,13 @@ bench_cfg j_fused 2700 --batches 10 8 --corr-dtype bfloat16 --no-remat \
     --fused-loss
 bench_cfg i_softsel_b8 1800 --batches 8 --corr-dtype bfloat16 --no-remat \
     --corr-impl softsel
+# scan-unroll: replicate the refinement body so XLA can pipeline across
+# iteration boundaries; compile cost grows with the factor, so bounded
+# timeouts and the mid factors only
+bench_cfg k_unroll2 2400 --batches 8 --corr-dtype bfloat16 --no-remat \
+    --scan-unroll 2
+bench_cfg k_unroll4 2700 --batches 8 --corr-dtype bfloat16 --no-remat \
+    --scan-unroll 4
 # isolated softsel rows give the per-lookup story for BENCH_NOTES
 step s_bf16 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
     --iters 20 --impls onehot softsel --grad --corr-dtype bfloat16

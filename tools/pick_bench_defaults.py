@@ -31,9 +31,13 @@ def flags_from_metric(metric: str):
         flags["corr_dtype"] = mc.group(1)
     if "_fusedloss" in metric:
         flags["fused_loss"] = True
-    mi = re.search(r"_(gather|onehot_t|onehot|softsel|pallas)$", metric.replace(
-        "_corrbfloat16", "").replace("_corrfloat32", "").replace(
-        "_fusedloss", ""))
+    mu = re.search(r"_unroll(\d+)", metric)
+    if mu:
+        flags["scan_unroll"] = int(mu.group(1))
+    mi = re.search(r"_(gather|onehot_t|onehot|softsel|pallas)$", re.sub(
+        r"_unroll\d+", "", metric.replace(
+            "_corrbfloat16", "").replace("_corrfloat32", "").replace(
+            "_fusedloss", "")))
     if mi:
         flags["corr_impl"] = mi.group(1)
     return flags
