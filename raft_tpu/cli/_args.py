@@ -1,8 +1,10 @@
 """Shared CLI argument groups.
 
-One definition of the correlation-backend knobs for every entry point
-(demo, evaluate, profile_step) so the flags and their RAFTConfig plumbing
-cannot drift apart. Validation of the VALUES lives in
+One definition of the per-step RAFTConfig performance knobs — the
+correlation-backend pair (corr_impl/corr_dtype) plus the refinement-loop
+scan_unroll — for every entry point that builds a model config (demo,
+evaluate, train, infer_bench, profile_step) so the flags and their
+RAFTConfig plumbing cannot drift apart. Validation of the VALUES lives in
 ``RAFTConfig.__post_init__`` — the single choke point every caller
 (including bench.py's dash-style flags) already goes through.
 """
@@ -20,10 +22,15 @@ def add_corr_args(p: argparse.ArgumentParser) -> None:
                    choices=["float32", "bfloat16"],
                    help="correlation-pyramid storage dtype; 'bfloat16' "
                         "halves volume traffic (see RAFTConfig.corr_dtype)")
+    p.add_argument("--scan_unroll", "--scan-unroll", type=int, default=None,
+                   help="refinement-loop lax.scan unroll factor; >1 lets "
+                        "XLA pipeline across iteration boundaries (see "
+                        "RAFTConfig.scan_unroll)")
 
 
 def corr_overrides(args: argparse.Namespace) -> dict:
     """RAFTConfig kwargs for the flags :func:`add_corr_args` added."""
     return {k: v for k, v in (("corr_impl", args.corr_impl),
-                              ("corr_dtype", args.corr_dtype))
+                              ("corr_dtype", args.corr_dtype),
+                              ("scan_unroll", args.scan_unroll))
             if v is not None}

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 
+from raft_tpu.cli._args import add_corr_args, corr_overrides
 from raft_tpu.config import RAFTConfig, TrainConfig, stage_config
 
 
@@ -56,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "of a real dataset — the full decode→augment→collate "
                         "pipeline still runs (on-chip training evidence when "
                         "datasets can't be staged; the sandbox has no egress)")
+    # the measured-best step config (bench ladder: bf16 volumes, onehot)
+    # must be reachable from real training runs, not just from bench.py
+    add_corr_args(p)
     return p
 
 
@@ -63,7 +67,8 @@ def configs_from_args(args) -> tuple[RAFTConfig, TrainConfig]:
     model_cfg = RAFTConfig(
         small=args.small, dropout=args.dropout,
         alternate_corr=args.alternate_corr,
-        mixed_precision=args.mixed_precision)
+        mixed_precision=args.mixed_precision,
+        **corr_overrides(args))
     overrides = dict(
         name=args.name, restore_ckpt=args.restore_ckpt, iters=args.iters,
         epsilon=args.epsilon, clip=args.clip, add_noise=args.add_noise,

@@ -183,3 +183,20 @@ class TestSyntheticTrainCLI:
         before = sorted(os.listdir(root))
         _synthetic_loader(4, cfg)
         assert sorted(os.listdir(root)) == before
+
+
+def test_train_cli_exposes_step_config_knobs():
+    """The measured-best step config (bf16 volumes, lookup backend, scan
+    unroll) must be reachable from the real training CLI, not just from
+    bench.py."""
+    from raft_tpu.cli.train import build_parser, configs_from_args
+
+    m, _ = configs_from_args(build_parser().parse_args(
+        ["--stage", "chairs", "--corr_dtype", "bfloat16",
+         "--corr_impl", "onehot_t", "--scan_unroll", "2"]))
+    assert (m.corr_dtype, m.corr_impl, m.scan_unroll) == (
+        "bfloat16", "onehot_t", 2)
+    # reference-parity defaults stay untouched when the flags are absent
+    m2, _ = configs_from_args(build_parser().parse_args(["--stage", "chairs"]))
+    assert (m2.corr_dtype, m2.corr_impl, m2.scan_unroll) == (
+        "float32", "onehot", 1)
