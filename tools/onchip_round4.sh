@@ -121,7 +121,7 @@ fi
 # ---- 3. the decision ladder the round-3 window never reached ----------
 # fused subpixel-domain loss frees the ~560 MB prediction stack +
 # cotangent: try batch 10 FIRST (the stack was part of why b10 OOM'd)
-bench_cfg j_fused 2700 --batches 10 8 --corr-dtype bfloat16 --no-remat \
+bench_cfg j_fused 2700 --batches 12 10 8 --corr-dtype bfloat16 --no-remat \
     --fused-loss
 bench_cfg i_softsel_b8 1800 --batches 8 --corr-dtype bfloat16 --no-remat \
     --corr-impl softsel
@@ -178,6 +178,10 @@ step train_rate 1800 python -m raft_tpu.cli.train --name r4rate \
 step infer_bf16_v2 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024 \
     --corr_dtype bfloat16
 step infer_fp32_v2 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024
+# serving-side unroll probe: fwd-only, 20 iters — pipelining has more
+# boundaries to cross here than in the 12-iter train step
+step infer_bf16_unroll2 2400 python -m raft_tpu.cli.infer_bench \
+    --hw 440 1024 --corr_dtype bfloat16 --scan_unroll 2
 
 # ---- 6. fresh trace at the current winner (next-bottleneck hunt) ------
 # profile exactly the config BENCH_DEFAULTS.json now pins
