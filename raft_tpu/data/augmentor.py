@@ -81,9 +81,15 @@ def adjust_saturation(img, factor):
 def adjust_hue(img, factor):
     """factor in [-0.5, 0.5] — fraction of the hue circle (PIL semantics)."""
     hsv = cv2.cvtColor(img, cv2.COLOR_RGB2HSV)
-    # cv2 uint8 hue range is [0, 180)
-    shift = np.uint8(int(factor * 180.0) % 180)
-    hsv[..., 0] = (hsv[..., 0] + shift) % 180
+    # cv2 uint8 hue range is [0, 180): express the add-mod as a 256x3
+    # per-channel LUT (identity on S/V) — one SIMD pass instead of a
+    # strided numpy gather+add+mod on the interleaved H plane; identical
+    # by construction since every H value is < 180
+    shift = int(factor * 180.0) % 180
+    lut = np.empty((256, 3), np.uint8)
+    lut[:, 0] = (np.arange(256) + shift) % 180
+    lut[:, 1] = lut[:, 2] = np.arange(256)
+    hsv = cv2.LUT(hsv, lut.reshape(1, 256, 3))
     return cv2.cvtColor(hsv, cv2.COLOR_HSV2RGB)
 
 
@@ -183,6 +189,13 @@ class FlowAugmentor:
         scale_x = np.clip(scale_x, min_scale, None)
         scale_y = np.clip(scale_y, min_scale, None)
 
+        # flow's scalar multiplies (resize rescale, flip signs) are DEFERRED
+        # to after the crop: each surviving element then sees the identical
+        # sequence of float multiplies (order preserved), so the result is
+        # bit-exact while the multiplies materialize crop-size arrays
+        # instead of full-frame ones — the loader's per-sample CPU is the
+        # binding resource on the 1-core host (cli/loader_bench.py)
+        flow_scales = []
         if self.rng.rand() < self.spatial_aug_prob:
             img1 = cv2.resize(img1, None, fx=scale_x, fy=scale_y,
                               interpolation=cv2.INTER_LINEAR)
@@ -190,17 +203,19 @@ class FlowAugmentor:
                               interpolation=cv2.INTER_LINEAR)
             flow = cv2.resize(flow, None, fx=scale_x, fy=scale_y,
                               interpolation=cv2.INTER_LINEAR)
-            flow = flow * np.array([scale_x, scale_y], np.float32)
+            flow_scales.append(np.array([scale_x, scale_y], np.float32))
 
         if self.do_flip:
             if self.rng.rand() < self.h_flip_prob:
                 img1 = img1[:, ::-1]
                 img2 = img2[:, ::-1]
-                flow = flow[:, ::-1] * np.array([-1.0, 1.0], np.float32)
+                flow = flow[:, ::-1]
+                flow_scales.append(np.array([-1.0, 1.0], np.float32))
             if self.rng.rand() < self.v_flip_prob:
                 img1 = img1[::-1, :]
                 img2 = img2[::-1, :]
-                flow = flow[::-1, :] * np.array([1.0, -1.0], np.float32)
+                flow = flow[::-1, :]
+                flow_scales.append(np.array([1.0, -1.0], np.float32))
 
         y0 = self.rng.randint(0, img1.shape[0] - self.crop_size[0])
         x0 = self.rng.randint(0, img1.shape[1] - self.crop_size[1])
@@ -208,6 +223,8 @@ class FlowAugmentor:
         img1 = img1[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
         img2 = img2[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
         flow = flow[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        for s in flow_scales:
+            flow = flow * s
         return img1, img2, flow
 
     def __call__(self, img1, img2, flow):

@@ -252,3 +252,26 @@ class TestFlowViz:
         ja = flow_to_image(a, rad_max=None)
         jb = flow_to_image(b, rad_max=None)
         assert not np.array_equal(ja[0, 0], jb[0, 0])
+
+
+class TestAdjustHue:
+    def test_circular_shift_exact_both_signs(self):
+        """Hue add must be modular on cv2's [0,180) circle (the analog of
+        PIL's full-range uint8 wrap that torchvision rides). The previous
+        implementation added the shift in uint8, wrapping at 256 BEFORE
+        the %180 and corrupting hues whenever h+shift >= 256 — which every
+        negative factor (shift in (90,180) after %180) hit."""
+        import cv2
+
+        from raft_tpu.data.augmentor import adjust_hue
+
+        rng = np.random.RandomState(0)
+        img = rng.randint(0, 255, (48, 64, 3), dtype=np.uint8)
+        for factor in (-0.159, -0.01, 0.0, 0.07, 0.159, 0.5):
+            hsv = cv2.cvtColor(img, cv2.COLOR_RGB2HSV)
+            h = hsv[..., 0].astype(np.int32)
+            hsv[..., 0] = ((h + int(factor * 180.0) % 180) % 180
+                           ).astype(np.uint8)
+            want = cv2.cvtColor(hsv, cv2.COLOR_HSV2RGB)
+            got = adjust_hue(img.copy(), factor)
+            assert np.array_equal(got, want), factor
