@@ -65,6 +65,10 @@ def main(argv=None):
     p.add_argument("--batch", type=int, default=6)
     p.add_argument("--samples", type=int, default=48)
     p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--no-clamp", action="store_true",
+                   help="bypass the host-aware worker clamp to measure "
+                        "the contended configuration (how the clamp "
+                        "policy itself gets re-validated)")
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--step-pairs-per-sec", type=float, default=None,
                    help="measured TPU step throughput to compare against")
@@ -77,7 +81,8 @@ def main(argv=None):
               f"{time.perf_counter() - t0:.1f}s")
 
         ds = build_dataset(root)
-        loader = PrefetchLoader(ds, args.batch, num_workers=args.workers)
+        loader = PrefetchLoader(ds, args.batch, num_workers=args.workers,
+                                clamp=not args.no_clamp)
 
         # warm epoch (page cache, thread spin-up), then timed epochs
         for _ in loader:
@@ -90,7 +95,8 @@ def main(argv=None):
         dt = time.perf_counter() - t0
         rate = pairs / dt
         print(f"loader: {pairs} pairs in {dt:.2f}s = {rate:.1f} pairs/s "
-              f"(batch {args.batch}, {args.workers} workers)")
+              f"(batch {args.batch}, {loader.num_workers} workers "
+              f"effective of {args.workers} requested)")
         if args.step_pairs_per_sec:
             ratio = rate / args.step_pairs_per_sec
             verdict = "OK (loader not binding)" if ratio >= 1.5 else \
