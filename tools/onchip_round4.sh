@@ -182,6 +182,13 @@ step infer_fp32_v2 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024
 # boundaries to cross here than in the 12-iter train step
 step infer_bf16_unroll2 2400 python -m raft_tpu.cli.infer_bench \
     --hw 440 1024 --corr_dtype bfloat16 --scan_unroll 2
+# softsel accuracy at trained weights (ADVICE r3: its bf16 selection
+# GEMMs round the bilinear weights — pin the cost in the same window
+# that measures its speed; torch flows come from the r3 cache)
+step trained_parity_softsel 2400 python tools/trained_parity.py \
+    --corr_impl softsel
+cp /root/.cache/raft_tpu/ref_ckpt/trained_parity_softsel.json \
+    /root/repo/TRAINED_PARITY_softsel_onchip.json 2>/dev/null || true
 
 # ---- 6. fresh trace at the current winner (next-bottleneck hunt) ------
 # profile exactly the config BENCH_DEFAULTS.json now pins
@@ -213,4 +220,4 @@ log "round4 runbook complete"
 snap
 commit_msmt "On-chip round-4 artifacts: ladder rows, parity, bisect" \
     ONCHIP_r04.log CRASH_BISECT_r04.log TRAINED_PARITY_onchip.json \
-    BENCH_DEFAULTS.json
+    TRAINED_PARITY_softsel_onchip.json BENCH_DEFAULTS.json

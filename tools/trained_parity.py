@@ -78,14 +78,16 @@ def torch_flow(pth, img1, img2, small, iters):
     return flow[0].permute(1, 2, 0).numpy()
 
 
-def jax_flow(pth, img1, img2, small, iters, corr_dtype="float32"):
+def jax_flow(pth, img1, img2, small, iters, corr_dtype="float32",
+             corr_impl=None):
     import jax.numpy as jnp
 
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models import RAFT
     from raft_tpu.tools.convert import load_pth
 
-    cfg = RAFTConfig(small=small, corr_dtype=corr_dtype)
+    extra = {"corr_impl": corr_impl} if corr_impl else {}
+    cfg = RAFTConfig(small=small, corr_dtype=corr_dtype, **extra)
     variables = load_pth(pth, cfg)
     model = RAFT(cfg)
     _, flow = model.apply(variables, jnp.asarray(img1[None]),
@@ -105,6 +107,13 @@ def main():
     p.add_argument("--hw", type=int, nargs=2, default=[368, 768],
                    help="center-crop of the 436x1024 demo frames; must be "
                         "/8 with H/64>=2 (both implementations need it)")
+    p.add_argument("--corr_impl", "--corr-impl", default=None,
+                   # no choices=: RAFTConfig.__post_init__ is the single
+                   # validator, so a new backend needs no edit here
+                   help="lookup backend for the jax side — lets any "
+                        "backend's accuracy be pinned at TRAINED weights "
+                        "(e.g. softsel's bf16 selection-weight rounding) "
+                        "in the same chip window that measures its speed")
     p.add_argument("--matmul-precision", default="highest",
                    choices=["default", "highest"],
                    help="'highest' forces exact fp32 MXU passes for convs/"
@@ -138,14 +147,15 @@ def main():
             continue
         ft = torch_flow_cached(pth, img1, img2, small, args.iters,
                                args.ckpt_dir)
-        fj = jax_flow(pth, img1, img2, small, args.iters)
+        fj = jax_flow(pth, img1, img2, small, args.iters,
+                      corr_impl=args.corr_impl)
         diff = np.abs(ft - fj)
         rec = {"flow_mag_max": round(float(np.abs(ft).max()), 2),
                "max_diff_px": float(diff.max()),
                "mean_diff_px": float(diff.mean())}
         if not small:
             fb = jax_flow(pth, img1, img2, small, args.iters,
-                          corr_dtype="bfloat16")
+                          corr_dtype="bfloat16", corr_impl=args.corr_impl)
             epe = np.linalg.norm(fb - fj, axis=-1)
             # EPE of bf16-volume flow against the fp32-volume flow: the
             # accuracy cost of halving the dominant HBM traffic
@@ -154,9 +164,13 @@ def main():
         results[name] = rec
         print(name, json.dumps(rec), flush=True)
 
-    out = osp.join(args.ckpt_dir, "trained_parity.json")
+    # impl variants get their own file — the canonical (default-impl)
+    # record must not be clobbered by a backend-accuracy follow-up
+    tag = f"_{args.corr_impl}" if args.corr_impl else ""
+    out = osp.join(args.ckpt_dir, f"trained_parity{tag}.json")
     with open(out, "w") as f:
-        json.dump({"iters": args.iters, "hw": args.hw, **results}, f,
+        json.dump({"iters": args.iters, "hw": args.hw,
+                   "corr_impl": args.corr_impl or "default", **results}, f,
                   indent=1)
     print("wrote", out)
     return 0 if results else 1
