@@ -205,6 +205,8 @@ if d.get("corr_impl"):
     flags += ["--corr_impl", d["corr_impl"]]
 if d.get("fused_loss"):
     flags.append("--fused_loss")
+if d.get("scan_unroll", 1) != 1:
+    flags += ["--scan_unroll", str(d["scan_unroll"])]
 print(" ".join(flags))
 EOF
 )
