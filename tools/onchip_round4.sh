@@ -132,6 +132,13 @@ bench_cfg k_unroll2 2400 --batches 8 --corr-dtype bfloat16 --no-remat \
     --scan-unroll 2
 bench_cfg k_unroll4 2700 --batches 8 --corr-dtype bfloat16 --no-remat \
     --scan-unroll 4
+# compositions: the levers are independent (memory, lerp-chain, pipeline)
+# so if two singles win, their product is the candidate default — measure
+# it in THIS window instead of waiting a round
+bench_cfg m_fused_softsel 2700 --batches 10 8 --corr-dtype bfloat16 \
+    --no-remat --fused-loss --corr-impl softsel
+bench_cfg n_fused_unroll2 2700 --batches 10 8 --corr-dtype bfloat16 \
+    --no-remat --fused-loss --scan-unroll 2
 # isolated softsel rows give the per-lookup story for BENCH_NOTES
 step s_bf16 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
     --iters 20 --impls onehot softsel --grad --corr-dtype bfloat16
