@@ -203,6 +203,30 @@ def _apply_measured_defaults(args, passed):
         log(f"BENCH_DEFAULTS.json applied: {applied}")
 
 
+def _apply_crash_resume(args):
+    """Fold in ``RAFT_BENCH_BATCHES`` (set only by this script's own
+    crash-retry re-exec) so the fresh process picks the ladder back up at
+    the rung that crashed instead of re-attempting rungs the OOM loop
+    already eliminated. Runs AFTER _apply_measured_defaults — the re-exec
+    list must win over both parser and JSON defaults."""
+    resume = os.environ.get("RAFT_BENCH_BATCHES")
+    if not resume:
+        return
+    try:
+        batches = [int(b) for b in resume.split()]
+    except ValueError:
+        log(f"ignoring malformed RAFT_BENCH_BATCHES={resume!r}")
+        return
+    # same positivity bar _DEFAULTS_SCHEMA holds a JSON batches list to —
+    # a stale manual export of "0" must not make run(0, ...) emit a
+    # "successful" 0.0
+    if batches and all(b > 0 for b in batches):
+        args.batches = batches
+        log(f"crash-retry resume: batches={args.batches}")
+    else:
+        log(f"ignoring non-positive RAFT_BENCH_BATCHES={resume!r}")
+
+
 def _build_parser(suppress=False):
     """``suppress=True`` builds the twin parser whose namespace contains
     ONLY flags the user actually typed — how _apply_measured_defaults
@@ -255,6 +279,7 @@ def main():
     args = p.parse_args()
     passed = vars(_build_parser(suppress=True).parse_args()).keys()
     _apply_measured_defaults(args, passed)
+    _apply_crash_resume(args)
     if args.remat_policy and not args.remat:
         p.error("--remat-policy requires --remat (without it the policy "
                 "is a silent no-op and the run measures a baseline step)")
@@ -330,7 +355,7 @@ def main():
     last_err = None
     # whole-run budget: one transient-crash re-exec (0 if already retried)
     crash_retries_left = 0 if os.environ.get("RAFT_BENCH_CRASH_RETRIED") else 1
-    for batch_size in args.batches:
+    for rung_i, batch_size in enumerate(args.batches):
         if time.monotonic() - START > args.deadline_s:
             log("deadline reached before attempt")
             break
@@ -361,8 +386,14 @@ def main():
                 log(f"TPU worker crash ({type(exc).__name__}); waiting "
                     "120s, then re-exec with a fresh client")
                 time.sleep(120)
+                # resume the ladder at the rung that crashed — rungs the
+                # OOM loop already eliminated must not be re-compiled in
+                # the fresh process (ADVICE r4); slice by position so a
+                # repeated rung doesn't resume at its first occurrence
+                remaining = args.batches[rung_i:]
                 env = dict(os.environ, RAFT_BENCH_CRASH_RETRIED="1",
-                           RAFT_BENCH_ELAPSED=str(time.monotonic() - START))
+                           RAFT_BENCH_ELAPSED=str(time.monotonic() - START),
+                           RAFT_BENCH_BATCHES=" ".join(map(str, remaining)))
                 os.execve(sys.executable,
                           [sys.executable, os.path.abspath(__file__)]
                           + sys.argv[1:], env)

@@ -69,6 +69,9 @@ def main(argv=None):
     suffix = "".join(
         f"_{v}" for v in (args.corr_impl,
                           f"corr{args.corr_dtype}" if args.corr_dtype
+                          else None,
+                          f"unroll{args.scan_unroll}"
+                          if args.scan_unroll not in (None, 1)
                           else None) if v)
     print(json.dumps({
         "metric": f"raft_{tag}_infer_{H}x{W}_b{args.batch}"

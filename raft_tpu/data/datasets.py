@@ -7,9 +7,11 @@ list-replication trick (``__rmul__``, datasets.py:93-96) and the same stage
 recipes, e.g. sintel-stage mix 100·sc + 100·sf + 200·k + 5·h + things
 (datasets.py:218-221).
 
-FlyingChairs needs the upstream ``chairs_split.txt`` (1=train, 2=val). We do
-not bundle it; pass ``split_file`` or drop it in the dataset root
-(datasets.py:129 loads it from the working directory).
+FlyingChairs needs the upstream ``chairs_split.txt`` (1=train, 2=val). A
+copy is bundled at ``raft_tpu/data/chairs_split.txt`` (a data manifest,
+NOTICE-attributed) and found automatically after the working directory
+and dataset root are searched; pass ``split_file`` to override (the
+reference loads it from the working directory, datasets.py:129).
 """
 
 from __future__ import annotations

@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import warnings
 from typing import Dict, Iterator, Optional
 
 import numpy as np
@@ -53,9 +54,10 @@ class PrefetchLoader:
         self.num_workers = (max(1, min(num_workers, spare)) if clamp
                             else max(1, num_workers))
         if self.num_workers != num_workers:
-            print(f"PrefetchLoader: clamped num_workers {num_workers} -> "
-                  f"{self.num_workers} ({cores} usable cores; extra "
-                  "threads only add GIL contention)", flush=True)
+            warnings.warn(
+                f"PrefetchLoader: clamped num_workers {num_workers} -> "
+                f"{self.num_workers} ({cores} usable cores; extra "
+                "threads only add GIL contention)", stacklevel=2)
         self.drop_last = drop_last
         self.seed = seed
         self.prefetch = prefetch

@@ -175,6 +175,48 @@ class TestDeadlineCarryover:
         assert t1 - mod.START >= 1234.5
 
 
+class TestCrashResumeBatches:
+    """RAFT_BENCH_BATCHES round-trip: the re-exec producer serializes the
+    surviving rungs space-separated; the consumer must parse them back
+    over every other default, and a malformed value must fall back to the
+    CLI/JSON batches (the env var is self-produced, but a serialization
+    refactor must not silently break resume)."""
+
+    def test_env_overrides_batches(self, modules, monkeypatch):
+        bench, _ = modules
+        monkeypatch.setenv("RAFT_BENCH_BATCHES", "6 4")
+        ns = argparse.Namespace(batches=[8, 6, 4])
+        bench._apply_crash_resume(ns)
+        assert ns.batches == [6, 4]
+
+    def test_producer_serialization_roundtrips(self, modules, monkeypatch):
+        # exactly the expression the crash handler uses to build the env:
+        # a positional slice of the ladder from the crashed rung onward
+        bench, _ = modules
+        ladder = [12, 10, 8]
+        env_val = " ".join(map(str, ladder[1:]))
+        monkeypatch.setenv("RAFT_BENCH_BATCHES", env_val)
+        ns = argparse.Namespace(batches=ladder)
+        bench._apply_crash_resume(ns)
+        assert ns.batches == [10, 8]
+
+    def test_malformed_empty_or_nonpositive_keep_cli_batches(
+            self, modules, monkeypatch):
+        bench, _ = modules
+        for bad in ("zap", "8,6", "", "0", "-4 2"):
+            monkeypatch.setenv("RAFT_BENCH_BATCHES", bad)
+            ns = argparse.Namespace(batches=[8, 6])
+            bench._apply_crash_resume(ns)
+            assert ns.batches == [8, 6], bad
+
+    def test_absent_env_is_noop(self, modules, monkeypatch):
+        bench, _ = modules
+        monkeypatch.delenv("RAFT_BENCH_BATCHES", raising=False)
+        ns = argparse.Namespace(batches=[8])
+        bench._apply_crash_resume(ns)
+        assert ns.batches == [8]
+
+
 class TestScanUnrollPlumbing:
     def test_metric_tag_roundtrip(self, modules):
         _, pick = modules

@@ -46,8 +46,10 @@ def flags_from_metric(metric: str):
 def with_fallbacks(batches):
     """Measured batch first, then smaller rungs: a driver-time OOM at the
     winner (e.g. HBM fragmentation) must degrade bench.py to a slower
-    number, not to 0.0."""
-    return batches + [b for b in (8, 6, 4, 2) if b < batches[0]]
+    number, not to 0.0. The rung list includes every batch the ladder
+    measures (12/10/8/...), so a winner of 12 falls back through 10
+    rather than skipping straight to 8 (ADVICE r4)."""
+    return batches + [b for b in (10, 8, 6, 4, 2) if b < batches[0]]
 
 
 def main():
