@@ -212,6 +212,12 @@ def _apply_crash_resume(args):
     resume = os.environ.get("RAFT_BENCH_BATCHES")
     if not resume:
         return
+    if not os.environ.get("RAFT_BENCH_CRASH_RETRIED"):
+        # only this script's own re-exec sets both vars; a stale manual
+        # export of the batches list alone must not override --batches
+        log(f"ignoring RAFT_BENCH_BATCHES={resume!r} without "
+            "RAFT_BENCH_CRASH_RETRIED (not a crash-retry re-exec)")
+        return
     try:
         batches = [int(b) for b in resume.split()]
     except ValueError:

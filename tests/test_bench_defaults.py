@@ -184,6 +184,7 @@ class TestCrashResumeBatches:
 
     def test_env_overrides_batches(self, modules, monkeypatch):
         bench, _ = modules
+        monkeypatch.setenv("RAFT_BENCH_CRASH_RETRIED", "1")
         monkeypatch.setenv("RAFT_BENCH_BATCHES", "6 4")
         ns = argparse.Namespace(batches=[8, 6, 4])
         bench._apply_crash_resume(ns)
@@ -195,14 +196,26 @@ class TestCrashResumeBatches:
         bench, _ = modules
         ladder = [12, 10, 8]
         env_val = " ".join(map(str, ladder[1:]))
+        monkeypatch.setenv("RAFT_BENCH_CRASH_RETRIED", "1")
         monkeypatch.setenv("RAFT_BENCH_BATCHES", env_val)
         ns = argparse.Namespace(batches=ladder)
         bench._apply_crash_resume(ns)
         assert ns.batches == [10, 8]
 
+    def test_batches_without_retry_flag_ignored(self, modules, monkeypatch):
+        # only the script's own re-exec sets BOTH vars; a stale manual
+        # export of the list alone must not override --batches
+        bench, _ = modules
+        monkeypatch.delenv("RAFT_BENCH_CRASH_RETRIED", raising=False)
+        monkeypatch.setenv("RAFT_BENCH_BATCHES", "2")
+        ns = argparse.Namespace(batches=[12])
+        bench._apply_crash_resume(ns)
+        assert ns.batches == [12]
+
     def test_malformed_empty_or_nonpositive_keep_cli_batches(
             self, modules, monkeypatch):
         bench, _ = modules
+        monkeypatch.setenv("RAFT_BENCH_CRASH_RETRIED", "1")
         for bad in ("zap", "8,6", "", "0", "-4 2"):
             monkeypatch.setenv("RAFT_BENCH_BATCHES", bad)
             ns = argparse.Namespace(batches=[8, 6])

@@ -23,7 +23,7 @@
 set -u
 cd /root/repo
 OUT=${1:-/tmp/crash_bisect.out}
-MARK=/root/.cache/raft_tpu/r4_markers
+MARK=${RAFT_R5_MARK:-/root/.cache/raft_tpu/r5_markers}
 mkdir -p "$MARK"
 log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
 probe() {
@@ -56,7 +56,7 @@ cell() {
         log "post-$name probe: worker DEAD (crash-on-exit reproduced)"
     fi
     touch "$MARK/bisect_$name"
-    cp "$OUT" /root/repo/CRASH_BISECT_r04.log 2>/dev/null || true
+    cp "$OUT" /root/repo/CRASH_BISECT_r05.log 2>/dev/null || true
 }
 
 CB="python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 --iters 20"
@@ -74,4 +74,4 @@ cell original_row $CB --impls gather onehot onehot_t --grad \
     --corr-dtype bfloat16
 
 log "bisect complete"
-cp "$OUT" /root/repo/CRASH_BISECT_r04.log 2>/dev/null || true
+cp "$OUT" /root/repo/CRASH_BISECT_r05.log 2>/dev/null || true

@@ -1,0 +1,282 @@
+#!/bin/bash
+# Round-5 on-chip runbook — the round-4 runbook re-armed for r5, now
+# TIERED against the historical ~100-minute chip window (VERDICT r4
+# item 7). Markers make every step resumable across windows; tiers just
+# order the work and add commit points so even a short window ends with
+# committed artifacts.
+#
+# Window-budget arithmetic (expected warm-cache durations from the r3
+# logs; caps are worst-case timeouts, not estimates):
+#   Tier A (the round's mandate, expect ~50 min, caps sum 95 min):
+#     bare_bench        expect ~15-20 min  cap 2700 s
+#     trained_parity    expect ~15-20 min  cap 2400 s
+#     j_fused ladder    expect ~10-15 min  cap 2700 s  (b12/10/8)
+#     -> commit after EACH of these (bench + parity already committed
+#        individually; ladder rows committed at the tier boundary)
+#   Tier B (defaults decision, expect ~35 min, caps sum 120 min):
+#     i_softsel_b8      expect ~8 min      cap 1800 s
+#     k_unroll2         expect ~10 min     cap 2400 s  (compile grows
+#                                                       with factor)
+#     m_fused_softsel   expect ~10 min     cap 2700 s
+#     n_fused_unroll2   expect ~10 min     cap 2700 s
+#     pick_defaults+bare reproduction      cap 2700 s (only if changed)
+#   Tier C (secondary numbers, expect ~45 min, caps sum 160 min):
+#     train_rate, infer_bf16/fp32/unroll2, softsel parity, corr_bench
+#     s_bf16 + pallas_regime, trace + summary
+#   Tier D (speculative / crash-poking, LAST):
+#     k_unroll4 (no hardware signal yet suggests it helps), then the
+#     crash bisect (deliberately reproduces the crash-on-exit mode).
+# A single ~100-min window at expected durations lands A + most of B;
+# markers carry the rest to the next window.
+set -u
+cd /root/repo
+OUT=${1:-/tmp/onchip_round5.out}
+# env-overridable so the control flow can be dry-run in a scratch clone
+MARK=${RAFT_R5_MARK:-/root/.cache/raft_tpu/r5_markers}
+LADDER=${RAFT_R5_LADDER:-/root/.cache/raft_tpu/r5_ladder}
+mkdir -p "$MARK" "$LADDER"
+# seed with earlier measured rows so a slow r5 set can't downgrade the
+# defaults pick below what is already proven
+cp -n /root/.cache/raft_tpu/r3_ladder/*.json "$LADDER"/ 2>/dev/null || true
+cp -n /root/.cache/raft_tpu/r4_ladder/*.json "$LADDER"/ 2>/dev/null || true
+log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
+snap() { cp "$OUT" /root/repo/ONCHIP_r05.log 2>/dev/null || true; }
+wait_chip() {
+    for _ in 1 2 3 4 5; do
+        if timeout -k 10 120 python -c \
+            "import jax; assert jax.devices()[0].platform != 'cpu'" \
+            >/dev/null 2>&1; then return 0; fi
+        log "chip not answering; waiting 60s"
+        sleep 60
+    done
+    return 1
+}
+step() {
+    local name=$1 tmo=$2; shift 2
+    if [ -e "$MARK/$name" ]; then log "skip $name (done)"; return 0; fi
+    wait_chip || { log "SKIP $name (chip unavailable)"; return 1; }
+    log "begin $name"
+    if timeout "$tmo" "$@" >> "$OUT" 2>&1; then
+        touch "$MARK/$name"; log "done $name"
+    else
+        local rc=$?
+        log "retry $name after 90s (rc=$rc)"
+        sleep 90
+        if timeout "$tmo" "$@" >> "$OUT" 2>&1; then
+            touch "$MARK/$name"; log "done $name (retry)"
+        else
+            log "FAILED rc=$? $name"
+        fi
+    fi
+    snap
+}
+bench_cfg() {
+    local tag=$1 tmo=$2; shift 2
+    if [ -e "$MARK/bench_$tag" ]; then log "skip bench_$tag"; return 0; fi
+    wait_chip || { log "SKIP bench_$tag (chip unavailable)"; return 1; }
+    log "begin bench_$tag: $*"
+    if timeout "$tmo" python bench.py --steps 10 "$@" \
+            > "$LADDER/$tag.json" 2>> "$OUT"; then
+        cat "$LADDER/$tag.json" >> "$OUT"
+        touch "$MARK/bench_$tag"; log "done bench_$tag"
+    else
+        log "FAILED bench_$tag rc=$?"; cat "$LADDER/$tag.json" >> "$OUT"
+    fi
+    snap
+}
+commit_msmt() {  # measurement artifacts only — no source changes
+    local msg=$1; shift
+    for f in "$@"; do git add "$f" 2>/dev/null || true; done
+    git diff --cached --quiet || git commit -q -m "$msg" -m \
+        "No-Verification-Needed: measurement logs and records only"
+}
+
+# ======================= TIER A =======================================
+# ---- A1. the driver-style bare bench, FIRST ---------------------------
+if [ ! -e "$MARK/bare_bench" ]; then
+    if wait_chip; then
+        log "begin bare_bench (no flags, exactly as the driver runs it)"
+        if timeout 2700 python bench.py \
+                > "$LADDER/bare.json" 2>> "$OUT"; then
+            cat "$LADDER/bare.json" >> "$OUT"
+            # only a real nonzero number counts as done
+            if python - "$LADDER/bare.json" <<'EOF'
+import json, sys
+row = json.load(open(sys.argv[1]))
+sys.exit(0 if row.get("value", 0) > 0 else 1)
+EOF
+            then
+                touch "$MARK/bare_bench"
+                cp "$LADDER/bare.json" /root/repo/BENCH_r05_local.json
+                snap
+                commit_msmt \
+                    "Record driver-style bare bench.py run for round 5" \
+                    BENCH_r05_local.json ONCHIP_r05.log
+                log "bare_bench committed"
+            else
+                log "bare_bench emitted a zero/failed row; will retry \
+next window"
+            fi
+        else
+            log "FAILED bare_bench rc=$?"
+        fi
+        snap
+    fi
+fi
+
+# ---- A2. exact-precision trained parity -------------------------------
+step trained_parity_exact 2400 python tools/trained_parity.py
+if [ -e "$MARK/trained_parity_exact" ] \
+        && [ ! -e "$MARK/trained_parity_committed" ]; then
+    cp /root/.cache/raft_tpu/ref_ckpt/trained_parity.json \
+        /root/repo/TRAINED_PARITY_onchip.json 2>/dev/null || true
+    commit_msmt \
+        "On-chip trained-weights parity at exact fp32 matmul precision" \
+        TRAINED_PARITY_onchip.json ONCHIP_r05.log
+    touch "$MARK/trained_parity_committed"
+fi
+
+# ---- A3. fused subpixel-domain loss: the highest-leverage ladder row --
+# (frees the ~560 MB prediction stack + cotangent; b10 was the stack's
+# OOM casualty, so try 12/10 before the proven 8)
+bench_cfg j_fused 2700 --batches 12 10 8 --corr-dtype bfloat16 --no-remat \
+    --fused-loss
+commit_msmt "r5 tier A ladder rows" ONCHIP_r05.log
+
+# ======================= TIER B =======================================
+bench_cfg i_softsel_b8 1800 --batches 8 --corr-dtype bfloat16 --no-remat \
+    --corr-impl softsel
+bench_cfg k_unroll2 2400 --batches 8 --corr-dtype bfloat16 --no-remat \
+    --scan-unroll 2
+# compositions: the levers are independent (memory, lerp-chain,
+# pipeline) so if two singles win, their product is the candidate
+# default — measure it in THIS window instead of waiting a round
+bench_cfg m_fused_softsel 2700 --batches 10 8 --corr-dtype bfloat16 \
+    --no-remat --fused-loss --corr-impl softsel
+bench_cfg n_fused_unroll2 2700 --batches 10 8 --corr-dtype bfloat16 \
+    --no-remat --fused-loss --scan-unroll 2
+
+# re-pick defaults; reproduce bare if they changed. The changed/decided
+# state lives in MARKERS, not `git diff` (a tier commit would clear the
+# diff and silently skip the reproduction the comment promises):
+#   defaults_changed  = the pick rewrote BENCH_DEFAULTS.json; a bare
+#                       reproduction is owed before it may be committed
+#   defaults_decided  = committed-state is settled (unchanged pick, or
+#                       reproduction landed)
+step pick_defaults_r5 120 python tools/pick_bench_defaults.py "$LADDER"
+if [ -e "$MARK/pick_defaults_r5" ] && [ ! -e "$MARK/defaults_decided" ] \
+        && [ ! -e "$MARK/defaults_changed" ]; then
+    if git diff --quiet BENCH_DEFAULTS.json; then
+        touch "$MARK/defaults_decided"  # pick kept the proven defaults
+    else
+        touch "$MARK/defaults_changed"
+        log "defaults re-picked - bare reproduction owed"
+    fi
+fi
+if [ -e "$MARK/defaults_changed" ] && [ ! -e "$MARK/bare_bench_final" ]; then
+    if wait_chip; then
+        log "reproducing re-picked defaults with a bare run"
+        if timeout 2700 python bench.py \
+                > "$LADDER/bare_final.json" 2>> "$OUT"; then
+            cat "$LADDER/bare_final.json" >> "$OUT"
+            if python - "$LADDER/bare_final.json" <<'EOF'
+import json, sys
+row = json.load(open(sys.argv[1]))
+sys.exit(0 if row.get("value", 0) > 0 else 1)
+EOF
+            then
+                touch "$MARK/bare_bench_final" "$MARK/defaults_decided"
+                cp "$LADDER/bare_final.json" /root/repo/BENCH_r05_local.json
+                snap
+                commit_msmt \
+                    "Bare bench reproduction at the re-picked defaults" \
+                    BENCH_r05_local.json BENCH_DEFAULTS.json ONCHIP_r05.log
+            fi
+        else
+            log "FAILED bare_bench_final rc=$?"
+        fi
+        snap
+    fi
+fi
+# only commit BENCH_DEFAULTS.json once its state is settled — re-picked
+# defaults must never ship without their bare-run reproduction
+if [ -e "$MARK/defaults_decided" ]; then
+    commit_msmt "r5 tier B ladder rows + defaults" ONCHIP_r05.log \
+        BENCH_DEFAULTS.json
+else
+    commit_msmt "r5 tier B ladder rows" ONCHIP_r05.log
+fi
+
+# ======================= TIER C =======================================
+# ---- clean trainer steps/s + serving re-measure -----------------------
+step train_rate 1800 python -m raft_tpu.cli.train --name r5rate \
+    --stage chairs --mixed_precision --synthetic 64 --num_steps 220 \
+    --val_freq 1000 --batch_size 8 --num_workers 4 \
+    --checkpoint_dir /root/.cache/raft_tpu/r5_rate --log_dir runs
+step infer_bf16_v2 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024 \
+    --corr_dtype bfloat16
+step infer_fp32_v2 2400 python -m raft_tpu.cli.infer_bench --hw 440 1024
+# serving-side unroll probe: fwd-only, 20 iters — pipelining has more
+# boundaries to cross here than in the 12-iter train step
+step infer_bf16_unroll2 2400 python -m raft_tpu.cli.infer_bench \
+    --hw 440 1024 --corr_dtype bfloat16 --scan_unroll 2
+# softsel accuracy at trained weights (its bf16 selection GEMMs round
+# the bilinear weights — pin the cost in the same window that measures
+# its speed; torch flows come from the r3 cache)
+step trained_parity_softsel 2400 python tools/trained_parity.py \
+    --corr_impl softsel
+cp /root/.cache/raft_tpu/ref_ckpt/trained_parity_softsel.json \
+    /root/repo/TRAINED_PARITY_softsel_onchip.json 2>/dev/null || true
+# isolated softsel rows give the per-lookup story for BENCH_NOTES
+step s_bf16 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
+    --iters 20 --impls onehot softsel --grad --corr-dtype bfloat16
+# the materialized-pyramid Pallas kernel's hypothesized regime is
+# large-resolution serving: measure it at the sintel serving geometry
+# or demote it to documented insurance (VERDICT item 6)
+step pallas_regime 1800 python -m raft_tpu.cli.corr_bench --batch 1 \
+    --hw 55 128 --iters 20 --impls onehot pallas
+
+# ---- fresh trace at the current winner (next-bottleneck hunt) ---------
+TRACE_FLAGS=$(python - <<'EOF'
+import json
+try:
+    d = json.load(open("BENCH_DEFAULTS.json"))
+except Exception:
+    d = {}
+flags = ["--batch", str(d.get("batches", [8])[0])]
+if d.get("corr_dtype"):
+    flags += ["--corr_dtype", d["corr_dtype"]]
+if d.get("corr_impl"):
+    flags += ["--corr_impl", d["corr_impl"]]
+if d.get("fused_loss"):
+    flags.append("--fused_loss")
+if d.get("scan_unroll", 1) != 1:
+    flags += ["--scan_unroll", str(d["scan_unroll"])]
+print(" ".join(flags))
+EOF
+)
+step trace_r5 2400 python -m raft_tpu.cli.profile_step $TRACE_FLAGS \
+    --steps 10 --trace-dir /tmp/raft_trace_r5
+step trace_summary_r5 1200 python -m raft_tpu.cli.trace_summary \
+    /tmp/raft_trace_r5
+commit_msmt "r5 tier C: trainer rate, serving rows, softsel parity, \
+trace" ONCHIP_r05.log TRAINED_PARITY_softsel_onchip.json
+
+# ======================= TIER D =======================================
+# unroll4 is two speculative rungs past any hardware signal — only
+# spend a window slot on it after everything above has numbers
+bench_cfg k_unroll4 2700 --batches 8 --corr-dtype bfloat16 --no-remat \
+    --scan-unroll 4
+# the crash bisect LAST — it deliberately pokes the crash mode
+step crash_bisect 5400 bash tools/crash_bisect.sh /tmp/crash_bisect.out
+# (crash_bisect.sh shares the same marker dir via RAFT_R5_MARK)
+
+log "round5 runbook complete"
+snap
+FINAL_FILES="ONCHIP_r05.log CRASH_BISECT_r05.log TRAINED_PARITY_onchip.json \
+TRAINED_PARITY_softsel_onchip.json"
+if [ -e "$MARK/defaults_decided" ]; then
+    FINAL_FILES="$FINAL_FILES BENCH_DEFAULTS.json"
+fi
+commit_msmt "On-chip round-5 artifacts: ladder rows, parity, bisect" \
+    $FINAL_FILES

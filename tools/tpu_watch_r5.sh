@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-5 watchdog: poll the axon tunnel every 5 min; on each window run
+# the marker-guarded round-5 runbook (bare driver bench FIRST, then
+# parity, ladder tiers, trace, crash bisect). Appends an outage trace to
+# OUTAGE_r05.log (committed at round end as the availability record).
+# Exits when the runbook's terminal markers all exist.
+set -u
+cd /root/repo
+LOG=/root/repo/OUTAGE_r05.log
+MARK=${RAFT_R5_MARK:-/root/.cache/raft_tpu/r5_markers}
+while true; do
+    if [ -e "$MARK/bare_bench" ] && [ -e "$MARK/trained_parity_exact" ] \
+            && [ -e "$MARK/bench_j_fused" ] \
+            && [ -e "$MARK/bench_i_softsel_b8" ] \
+            && [ -e "$MARK/train_rate" ] \
+            && [ -e "$MARK/infer_bf16_v2" ] \
+            && [ -e "$MARK/trace_summary_r5" ] \
+            && [ -e "$MARK/crash_bisect" ]; then
+        echo "$(date -u +%H:%M:%S) r5 runbook fully done" >> "$LOG"
+        exit 0
+    fi
+    if timeout -k 10 180 python -c \
+        "import jax; assert jax.devices()[0].platform != 'cpu'" \
+        >/dev/null 2>&1; then
+        echo "$(date -u +%H:%M:%S) chip up — running round-5 runbook" \
+            >> "$LOG"
+        bash tools/onchip_round5.sh /tmp/onchip_round5.out
+        echo "$(date -u +%H:%M:%S) runbook pass ended" >> "$LOG"
+    else
+        echo "$(date -u +%H:%M:%S) chip unavailable" >> "$LOG"
+    fi
+    sleep 300
+done
