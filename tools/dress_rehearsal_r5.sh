@@ -1,0 +1,137 @@
+#!/bin/bash
+# CPU dress rehearsal of every command class the round-5 on-chip runbook
+# (tools/onchip_round5.sh) will execute — tiny shapes, CPU backend, exit
+# codes asserted. The point: when a chip window opens, no window minute
+# may be lost to an argparse typo, an import error, or a broken code
+# path in a command that never ran on today's code. The NUMBERS here are
+# meaningless (CPU); only "the command executes end to end" counts.
+#
+# Mapping to runbook steps:
+#   bare/ladder bench rows  -> bench.py tiny (incl. fused/softsel/unroll
+#                              combos and the defaults fold-in path)
+#   trained parity rows     -> tools/trained_parity.py tiny crop, both
+#                              impls (torch flows come from / populate
+#                              the on-disk cache)
+#   train_rate              -> cli/train on the --synthetic loader path
+#                              (the exact path train_rate uses), plus
+#                              real_data_accept.sh --selftest for the
+#                              --data_root train + evaluate CLI path
+#   pick_defaults_r5        -> tools/pick_bench_defaults.py against a
+#                              scratch ladder dir
+#   infer rows              -> cli/infer_bench tiny, fp32/bf16/unroll2
+#   corr_bench rows         -> cli/corr_bench tiny, the exact impl sets
+#   trace + summary         -> cli/profile_step --trace-dir + trace_summary
+#   crash bisect            -> chip-only by nature (its cells are the
+#                              corr_bench commands above)
+set -u
+cd /root/repo
+export PYTHONPATH= JAX_PLATFORMS=cpu
+OUT=${1:-/tmp/dress_r5.out}
+: > "$OUT"
+FAILED=0
+rehearse() {
+    local name=$1 tmo=$2; shift 2
+    echo "=== $(date -u +%H:%M:%S) $name: $*" >> "$OUT"
+    if timeout "$tmo" "$@" >> "$OUT" 2>&1; then
+        echo "=== PASS $name" >> "$OUT"
+    else
+        echo "=== FAIL rc=$? $name" >> "$OUT"
+        FAILED=1
+    fi
+}
+
+# bench.py: bare-style (defaults fold-in + probe path) and the ladder's
+# flag combos, single tiny step each
+rehearse bench_bare 600 python bench.py --hw 64 64 --batches 2 \
+    --steps 1 --warmup 1
+rehearse bench_fused 600 python bench.py --hw 64 64 --batches 2 \
+    --steps 1 --warmup 1 --corr-dtype bfloat16 --no-remat --fused-loss
+rehearse bench_softsel 600 python bench.py --hw 64 64 --batches 2 \
+    --steps 1 --warmup 1 --corr-dtype bfloat16 --no-remat \
+    --corr-impl softsel
+rehearse bench_unroll2 600 python bench.py --hw 64 64 --batches 2 \
+    --steps 1 --warmup 1 --corr-dtype bfloat16 --no-remat --scan-unroll 2
+rehearse bench_fused_softsel 600 python bench.py --hw 64 64 --batches 2 \
+    --steps 1 --warmup 1 --corr-dtype bfloat16 --no-remat --fused-loss \
+    --corr-impl softsel
+rehearse bench_fused_unroll2 600 python bench.py --hw 64 64 --batches 2 \
+    --steps 1 --warmup 1 --corr-dtype bfloat16 --no-remat --fused-loss \
+    --scan-unroll 2
+
+# trained parity, tiny crop, both backends the runbook measures
+rehearse parity_default 1200 python tools/trained_parity.py \
+    --hw 128 256 --iters 4
+rehearse parity_softsel 1200 python tools/trained_parity.py \
+    --hw 128 256 --iters 4 --corr_impl softsel
+
+# serving rows
+rehearse infer_fp32 600 python -m raft_tpu.cli.infer_bench \
+    --hw 64 64 --iters 2 --reps 1
+rehearse infer_bf16 600 python -m raft_tpu.cli.infer_bench \
+    --hw 64 64 --iters 2 --reps 1 --corr_dtype bfloat16
+rehearse infer_unroll2 600 python -m raft_tpu.cli.infer_bench \
+    --hw 64 64 --iters 2 --reps 1 --corr_dtype bfloat16 --scan_unroll 2
+
+# corr_bench rows: the exact impl sets the runbook runs
+rehearse corr_softsel 900 python -m raft_tpu.cli.corr_bench --batch 2 \
+    --hw 24 32 --iters 4 --impls onehot softsel --grad \
+    --corr-dtype bfloat16
+rehearse corr_pallas 900 python -m raft_tpu.cli.corr_bench --batch 1 \
+    --hw 24 32 --iters 4 --impls onehot pallas
+
+# the --synthetic train path the runbook's train_rate step uses
+# (selftest below exercises the --data_root path instead)
+rehearse train_synthetic 1200 python -m raft_tpu.cli.train \
+    --name dressrate --stage chairs --small --image_size 64 64 \
+    --mixed_precision --synthetic 8 --num_steps 2 --val_freq 100 \
+    --batch_size 2 --num_workers 1 \
+    --checkpoint_dir /tmp/dress_ckpt_r5 --log_dir /tmp/dress_runs_r5
+
+# the defaults pick that gates the tier-B BENCH_DEFAULTS.json decision —
+# run a throwaway COPY of the tool (it writes BENCH_DEFAULTS.json one
+# dir above itself, so the copy writes under /tmp, leaving the repo's
+# real BENCH_DEFAULTS.json untouched) against a scratch ladder dir so a
+# pick bug surfaces here, not on chip
+DRESS_PICK=/tmp/dress_pick_r5
+rm -rf "$DRESS_PICK" && mkdir -p "$DRESS_PICK/tools" "$DRESS_PICK/ladder"
+cp tools/pick_bench_defaults.py "$DRESS_PICK/tools/"
+printf '%s\n' \
+    '{"metric": "raft_basic_train_chairs_368x496_bf16_b8_iters12_1chip_corrbfloat16", "value": 21.0, "unit": "img_pairs_per_sec"}' \
+    > "$DRESS_PICK/ladder/a.json"
+rehearse pick_defaults 120 python "$DRESS_PICK/tools/pick_bench_defaults.py" \
+    "$DRESS_PICK/ladder"
+
+# trace capture + headless summary — at the SAME flag set the runbook's
+# trace_r5 will derive from BENCH_DEFAULTS.json (batch forced tiny)
+rm -rf /tmp/dress_trace_r5
+TRACE_FLAGS=$(python - <<'EOF'
+import json
+try:
+    d = json.load(open("BENCH_DEFAULTS.json"))
+except Exception:
+    d = {}
+flags = []
+if d.get("corr_dtype"):
+    flags += ["--corr_dtype", d["corr_dtype"]]
+if d.get("corr_impl"):
+    flags += ["--corr_impl", d["corr_impl"]]
+if d.get("fused_loss"):
+    flags.append("--fused_loss")
+if d.get("scan_unroll", 1) != 1:
+    flags += ["--scan_unroll", str(d["scan_unroll"])]
+print(" ".join(flags))
+EOF
+)
+rehearse profile_step 900 python -m raft_tpu.cli.profile_step --batch 1 \
+    --hw 64 64 --steps 1 --trace-dir /tmp/dress_trace_r5 $TRACE_FLAGS
+rehearse trace_summary 300 python -m raft_tpu.cli.trace_summary \
+    /tmp/dress_trace_r5
+
+# train + evaluate CLI end to end (tiny fabricated layout + trained
+# fixture; asserts exit codes only)
+rehearse accept_selftest 1800 bash tools/real_data_accept.sh --selftest
+
+echo "=== $(date -u +%H:%M:%S) dress rehearsal done FAILED=$FAILED" >> "$OUT"
+# commit only the marker lines — the raw stdout is ~19 MB of CPU noise
+grep -E "^=== " "$OUT" > /root/repo/DRESS_r05.log 2>/dev/null || true
+exit $FAILED
