@@ -62,10 +62,17 @@ try:  # pallas import is gated so CPU-only installs still work
 except Exception:  # pragma: no cover
     _PALLAS_OK = False
 
-from raft_tpu.kernels.corr_pallas import _pad, pallas_available  # noqa: F401
+from raft_tpu.kernels.corr_pallas import (_fallback_interpret, _pad,  # noqa: F401
+                                          pallas_available)
 
-# interpret mode runs the kernel in pure XLA — used by CPU tests
+# interpret mode runs the kernel in pure XLA — forced by CPU tests via
+# monkeypatch; off-TPU backends fall back automatically (see
+# corr_pallas._interpret for why)
 _INTERPRET = False
+
+
+def _interpret() -> bool:
+    return _INTERPRET or _fallback_interpret()
 
 _NBUF = 8    # window-DMA ring depth; each transfer is ~(2r+2)·WSPAN·C·4 B
 _QTILE = 128  # queries per grid step
@@ -232,7 +239,7 @@ def _level_alt_pallas(f1: jax.Array, f2_p: jax.Array, x: jax.Array,
             pltpu.SemaphoreType.DMA((_NBUF,)),
             pltpu.VMEM((_QTILE, K + 1, K + 1), jnp.float32),
         ],
-        interpret=_INTERPRET,
+        interpret=_interpret(),
     )(base, wy, wx, f1.astype(jnp.float32), f2_p.astype(jnp.float32))
     # [y, x] window -> x-major flat channels (models.corr layout contract)
     return jnp.swapaxes(out[:, :N], -1, -2).reshape(B, N, K * K)

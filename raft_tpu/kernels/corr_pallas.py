@@ -50,6 +50,7 @@ corr.py:86, SURVEY.md §2).
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Sequence
 
 import jax
@@ -64,8 +65,31 @@ except Exception:  # pragma: no cover
     _PALLAS_OK = False
 
 
-# interpret mode runs the kernel in pure XLA — used by CPU tests
+# interpret mode runs the kernel in pure XLA — forced by CPU tests via
+# monkeypatch; off-TPU backends fall back to it automatically (Mosaic
+# rejects non-interpret pallas_call on CPU, so without the fallback
+# ``corr_impl="pallas"`` would be TPU-only — e.g. trained-weights parity
+# on the CPU host could not cover this backend)
 _INTERPRET = False
+
+
+def _fallback_interpret() -> bool:
+    """True when pallas_call must run in interpret mode because the
+    backend has no Mosaic support. Loud on purpose: a trace on a non-TPU
+    host (e.g. a StableHLO export destined for TPU) bakes the pure-XLA
+    interpret path into the artifact, and that must not happen
+    silently."""
+    if pallas_available():
+        return False
+    warnings.warn(
+        "pallas kernel lowered in interpret mode (non-TPU backend); an "
+        "export/AOT artifact traced here ships the pure-XLA path, not "
+        "the Mosaic kernel", stacklevel=3)
+    return True
+
+
+def _interpret() -> bool:
+    return _INTERPRET or _fallback_interpret()
 
 # Scoped-VMEM budget for ONE grid step of either kernel, covering
 # everything the Mosaic stack allocator charges: pipelined in/out blocks
@@ -300,7 +324,7 @@ def _level_lookup_pallas(vol_p: jax.Array, x: jax.Array, y: jax.Array,
             pltpu.VMEM((q_tile, K + 1, Wp), vol_p.dtype),
             pltpu.VMEM((q_tile, K + 1, K + 1), vol_p.dtype),
         ],
-        interpret=_INTERPRET,
+        interpret=_interpret(),
     )(y0, x0, wy, wx, vol_p)
     # [y, x] window -> x-major flat channels (models.corr layout contract)
     out = jnp.swapaxes(out[:, :N], -1, -2).reshape(B, N, K * K)
@@ -339,7 +363,7 @@ def _level_scatter_pallas(g: jax.Array, shape_p, vol_dtype, x: jax.Array,
             pltpu.VMEM((q_tile, K, K + 1), jnp.float32),
             pltpu.VMEM((q_tile, K + 1, Wp), jnp.float32),
         ],
-        interpret=_INTERPRET,
+        interpret=_interpret(),
     )(y0, x0, wy, wx, g)
     return dvol_p
 
