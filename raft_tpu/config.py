@@ -35,16 +35,19 @@ class RAFTConfig:
     # selection over the TRANSPOSED pixels-on-lanes volume — see
     # models/corr.build_corr_pyramid_t), 'softsel' (bilinear lerp folded
     # into the selection GEMMs), or 'pallas' (vectorized mask-select
-    # kernel, TPU only). On-chip at chairs geometry (BENCH_NOTES.md r3,
-    # v5e-1, per lookup): gather 294 ms fwd (scatter lowering makes its
-    # backward disqualifying); onehot 10.8 ms fwd / 14.0 fwd+grad; pallas
-    # 15.1 / 27.5 (losing in every regime measured so far — kept as the
-    # memory-regime insurance pending the r4 serving-geometry row);
-    # onehot_t whole-step A/B'd a wash vs onehot (24.32 vs 24.23
-    # pairs/s, ONCHIP_r03e.log — kept for its pixels-on-lanes layout,
-    # which spatial sharding prefers); softsel: tested fallback, no
-    # hardware number as of r4 (ladder row queued in
-    # tools/onchip_round4.sh). Re-benchmark with
+    # kernel; interpret-mode fallback off-TPU). Accuracy at trained
+    # weights is uniform across all five — basic max <=1.24e-5 px vs the
+    # live torch reference, TRAINED_PARITY_backends.json (r5) — so
+    # backend choice is decided on speed alone. On-chip at chairs
+    # geometry (BENCH_NOTES.md r3, v5e-1, per lookup): gather 294 ms fwd
+    # (scatter lowering makes its backward disqualifying); onehot 10.8
+    # ms fwd / 14.0 fwd+grad; pallas 15.1 / 27.5 (losing in every regime
+    # measured so far — kept as the memory-regime insurance pending the
+    # serving-geometry row); onehot_t whole-step A/B'd a wash vs onehot
+    # (24.32 vs 24.23 pairs/s, ONCHIP_r03e.log — kept for its
+    # pixels-on-lanes layout, which spatial sharding prefers); softsel:
+    # tested fallback, no hardware number as of r5 (ladder row queued in
+    # tools/onchip_round5.sh). Re-benchmark with
     # `python -m raft_tpu.cli.corr_bench` (+ --grad).
     corr_impl: str = "onehot"
     # storage dtype of the materialized correlation pyramid. The reference
