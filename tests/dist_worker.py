@@ -33,12 +33,33 @@ from raft_tpu.training.train_step import (create_train_state,  # noqa: E402
                                           make_train_step)
 
 
-def main(process_id: int, port: str) -> None:
+def batch_geometry(spatial: int):
+    """(B, H, W) for a given spatial factor — shared with the in-process
+    comparator so both sides can't drift. spatial>1 shards feature rows;
+    H must clear the 7x7-conv halo fence
+    (parallel/mesh.validate_spatial_extent)."""
+    return 2, (64 if spatial > 1 else 32), 32
+
+
+def make_global_batch(B, H, W):
+    """Deterministic global batch — shared with the in-process comparator
+    (tests/test_distributed_multiprocess.py) so both sides consume
+    byte-identical data."""
+    host = np.random.RandomState(0)
+    return {
+        "image1": host.rand(B, H, W, 3).astype(np.float32) * 255,
+        "image2": host.rand(B, H, W, 3).astype(np.float32) * 255,
+        "flow": host.randn(B, H, W, 2).astype(np.float32),
+        "valid": np.ones((B, H, W), np.float32),
+    }
+
+
+def main(process_id: int, port: str, spatial: int = 1) -> None:
     dist.initialize(f"localhost:{port}", 2, process_id)
     assert jax.process_count() == 2, jax.process_count()
 
-    mesh = make_mesh()  # all devices across both processes
-    B, H, W = 2, 32, 32
+    mesh = make_mesh(spatial=spatial)  # all devices across both processes
+    B, H, W = batch_geometry(spatial)
     model_cfg = RAFTConfig(small=True)
     train_cfg = TrainConfig(stage="chairs", num_steps=10, batch_size=B,
                             iters=1)
@@ -46,13 +67,7 @@ def main(process_id: int, port: str) -> None:
     state = create_train_state(model_cfg, train_cfg, rng, image_hw=(H, W))
     step = jax.jit(make_train_step(model_cfg, train_cfg))
 
-    host = np.random.RandomState(0)
-    gbatch = {
-        "image1": host.rand(B, H, W, 3).astype(np.float32) * 255,
-        "image2": host.rand(B, H, W, 3).astype(np.float32) * 255,
-        "flow": host.randn(B, H, W, 2).astype(np.float32),
-        "valid": np.ones((B, H, W), np.float32),
-    }
+    gbatch = make_global_batch(B, H, W)
     sl = dist.process_batch_slice(B)
     local = {k: v[sl] for k, v in gbatch.items()}
     with mesh:
@@ -65,4 +80,5 @@ def main(process_id: int, port: str) -> None:
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]), sys.argv[2])
+    main(int(sys.argv[1]), sys.argv[2],
+         int(sys.argv[3]) if len(sys.argv) > 3 else 1)

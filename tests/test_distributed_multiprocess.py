@@ -26,50 +26,67 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _single_process_loss() -> float:
-    """Same batch/seeds as dist_worker, on an in-process 2-device mesh."""
+def _single_process_loss(n_devices: int = 2, spatial: int = 1) -> float:
+    """Same batch/seeds as dist_worker, on an in-process mesh."""
     from raft_tpu.config import RAFTConfig, TrainConfig
     from raft_tpu.parallel.mesh import make_mesh, replicated, shard_batch
     from raft_tpu.training.train_step import (create_train_state,
                                               make_train_step)
+    from tests.dist_worker import batch_geometry, make_global_batch
 
-    B, H, W = 2, 32, 32
+    B, H, W = batch_geometry(spatial)
     model_cfg = RAFTConfig(small=True)
     train_cfg = TrainConfig(stage="chairs", num_steps=10, batch_size=B,
                             iters=1)
     rng = jax.random.PRNGKey(0)
     state = create_train_state(model_cfg, train_cfg, rng, image_hw=(H, W))
     step = jax.jit(make_train_step(model_cfg, train_cfg))
-    host = np.random.RandomState(0)
-    batch = {
-        "image1": host.rand(B, H, W, 3).astype(np.float32) * 255,
-        "image2": host.rand(B, H, W, 3).astype(np.float32) * 255,
-        "flow": host.randn(B, H, W, 2).astype(np.float32),
-        "valid": np.ones((B, H, W), np.float32),
-    }
-    mesh = make_mesh(2)
+    batch = make_global_batch(B, H, W)
+    mesh = make_mesh(n_devices, spatial=spatial)
     with mesh:
         state = jax.device_put(state, replicated(mesh))
         _, metrics = step(state, shard_batch(batch, mesh), rng)
     return float(metrics["loss"])
 
 
-def test_two_process_train_step_matches_single_process():
+def _run_two_process(spatial: int, local_devices: int) -> list:
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "dist_worker.py")
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    procs = [subprocess.Popen([sys.executable, worker, str(i), str(port)],
+    if local_devices > 1:
+        env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                            f"{local_devices}")
+    cmd_tail = [str(port)] + ([str(spatial)] if spatial > 1 else [])
+    procs = [subprocess.Popen([sys.executable, worker, str(i)] + cmd_tail,
                               stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True, env=env)
              for i in range(2)]
     outs = [p.communicate(timeout=540)[0] for p in procs]
     losses = []
+    total = 2 * local_devices
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
-        m = re.search(r"RESULT pid=\d+ loss=([\d.]+) procs=2 devices=2", out)
+        m = re.search(rf"RESULT pid=\d+ loss=([\d.]+) procs=2 "
+                      rf"devices={total}", out)
         assert m, f"worker {i} output malformed:\n{out[-2000:]}"
         losses.append(float(m.group(1)))
+    return losses
 
+
+def test_two_process_train_step_matches_single_process():
+    losses = _run_two_process(spatial=1, local_devices=1)
     assert losses[0] == losses[1]
     # same global computation as one process on a 2-device mesh
-    assert losses[0] == pytest.approx(_single_process_loss(), rel=1e-5)
+    assert losses[0] == pytest.approx(_single_process_loss(2), rel=1e-5)
+
+
+def test_two_process_spatial_mesh_matches_single_process():
+    """The multi-host pod shape: data axis across processes (the DCN-side
+    gradient psum), spatial axis across each process's TWO local devices
+    (the ICI-side halo exchanges) — mesh (data=2, spatial=2), each host
+    feeding only its batch rows at full height through host_local_batch
+    (which must split them over its local spatial shards)."""
+    losses = _run_two_process(spatial=2, local_devices=2)
+    assert losses[0] == losses[1]
+    assert losses[0] == pytest.approx(
+        _single_process_loss(4, spatial=2), rel=1e-5)
