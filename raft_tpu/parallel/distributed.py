@@ -69,15 +69,16 @@ def host_local_batch(batch: Dict[str, np.ndarray], mesh: Mesh
     batch without any host ever holding it all — the DCN-side analog of
     the reference's per-GPU scatter (train.py:138), but across hosts.
     """
-    from raft_tpu.parallel.mesh import validate_spatial_extent
+    from raft_tpu.parallel.mesh import validate_batch_extent
+
+    # same conv-halo fence as the single-process shard_batch path: the
+    # spatial axis is intra-process, so the local H *is* the global H
+    # being sharded
+    validate_batch_extent(batch, mesh)
 
     out: Dict[str, jax.Array] = {}
     for k, v in batch.items():
         if v.ndim == 4:
-            # same conv-halo fence shard_batch applies on the
-            # single-process path: the spatial axis is intra-process, so
-            # the local H *is* the global H being sharded
-            validate_spatial_extent(v.shape[1], mesh)
             spec = P("data", "spatial", None, None)
         elif v.ndim == 3:
             spec = P("data", "spatial", None)

@@ -91,12 +91,20 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch: dict, mesh: Mesh) -> dict:
-    """Device-put a host batch dict onto the mesh with train shardings."""
+def validate_batch_extent(batch: dict, mesh: Mesh) -> None:
+    """Apply the conv-halo spatial fence to a batch dict (first image
+    tensor decides — all 4-d entries share H). One definition for every
+    batch-sharding entry path (shard_batch here, host_local_batch on the
+    multi-host side) so the fence cannot drift between them."""
     for v in batch.values():
         if v.ndim == 4:
             validate_spatial_extent(v.shape[1], mesh)
             break
+
+
+def shard_batch(batch: dict, mesh: Mesh) -> dict:
+    """Device-put a host batch dict onto the mesh with train shardings."""
+    validate_batch_extent(batch, mesh)
     out = {}
     for k, v in batch.items():
         if v.ndim == 4:

@@ -1,0 +1,38 @@
+"""Print the measured-default CLI flags from ``BENCH_DEFAULTS.json``.
+
+One definition of the BENCH_DEFAULTS -> underscore-style CLI-flag mapping
+(profile_step / infer-style entry points), shared by the shell runbooks —
+``tools/onchip_round5.sh`` derives the trace config from it and
+``tools/dress_rehearsal_r5.sh`` rehearses profile_step at the same flags —
+so the two scripts cannot drift. ``--with-batch`` adds ``--batch N`` from
+the winning rung (the rehearsal forces its own tiny batch instead).
+"""
+
+import json
+import os
+import sys
+
+
+def flags(with_batch: bool) -> list:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        with open(os.path.join(repo, "BENCH_DEFAULTS.json")) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        d = {}
+    out = []
+    if with_batch:
+        out += ["--batch", str(d.get("batches", [8])[0])]
+    if d.get("corr_dtype"):
+        out += ["--corr_dtype", d["corr_dtype"]]
+    if d.get("corr_impl"):
+        out += ["--corr_impl", d["corr_impl"]]
+    if d.get("fused_loss"):
+        out.append("--fused_loss")
+    if d.get("scan_unroll", 1) != 1:
+        out += ["--scan_unroll", str(d["scan_unroll"])]
+    return out
+
+
+if __name__ == "__main__":
+    print(" ".join(flags("--with-batch" in sys.argv[1:])))

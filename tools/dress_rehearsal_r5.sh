@@ -102,26 +102,11 @@ rehearse pick_defaults 120 python "$DRESS_PICK/tools/pick_bench_defaults.py" \
     "$DRESS_PICK/ladder"
 
 # trace capture + headless summary — at the SAME flag set the runbook's
-# trace_r5 will derive from BENCH_DEFAULTS.json (batch forced tiny)
+# trace_r5 will derive from BENCH_DEFAULTS.json (batch forced tiny),
+# via the shared tools/bench_default_flags.py mapping
 rm -rf /tmp/dress_trace_r5
-TRACE_FLAGS=$(python - <<'EOF'
-import json
-try:
-    d = json.load(open("BENCH_DEFAULTS.json"))
-except Exception:
-    d = {}
-flags = []
-if d.get("corr_dtype"):
-    flags += ["--corr_dtype", d["corr_dtype"]]
-if d.get("corr_impl"):
-    flags += ["--corr_impl", d["corr_impl"]]
-if d.get("fused_loss"):
-    flags.append("--fused_loss")
-if d.get("scan_unroll", 1) != 1:
-    flags += ["--scan_unroll", str(d["scan_unroll"])]
-print(" ".join(flags))
-EOF
-)
+TRACE_FLAGS=$(python tools/bench_default_flags.py) || {
+    echo "=== FAIL bench_default_flags" >> "$OUT"; FAILED=1; TRACE_FLAGS=""; }
 rehearse profile_step 900 python -m raft_tpu.cli.profile_step --batch 1 \
     --hw 64 64 --steps 1 --trace-dir /tmp/dress_trace_r5 $TRACE_FLAGS
 rehearse trace_summary 300 python -m raft_tpu.cli.trace_summary \

@@ -237,24 +237,12 @@ step pallas_regime 1800 python -m raft_tpu.cli.corr_bench --batch 1 \
     --hw 55 128 --iters 20 --impls onehot pallas
 
 # ---- fresh trace at the current winner (next-bottleneck hunt) ---------
-TRACE_FLAGS=$(python - <<'EOF'
-import json
-try:
-    d = json.load(open("BENCH_DEFAULTS.json"))
-except Exception:
-    d = {}
-flags = ["--batch", str(d.get("batches", [8])[0])]
-if d.get("corr_dtype"):
-    flags += ["--corr_dtype", d["corr_dtype"]]
-if d.get("corr_impl"):
-    flags += ["--corr_impl", d["corr_impl"]]
-if d.get("fused_loss"):
-    flags.append("--fused_loss")
-if d.get("scan_unroll", 1) != 1:
-    flags += ["--scan_unroll", str(d["scan_unroll"])]
-print(" ".join(flags))
-EOF
-)
+if ! TRACE_FLAGS=$(python tools/bench_default_flags.py --with-batch); then
+    # tracing the wrong config would burn the window on a misleading
+    # measurement — surface the failure and pin the known default
+    log "bench_default_flags.py FAILED - tracing at --batch 8 fallback"
+    TRACE_FLAGS="--batch 8"
+fi
 step trace_r5 2400 python -m raft_tpu.cli.profile_step $TRACE_FLAGS \
     --steps 10 --trace-dir /tmp/raft_trace_r5
 step trace_summary_r5 1200 python -m raft_tpu.cli.trace_summary \
