@@ -230,6 +230,41 @@ class TestCrashResumeBatches:
         assert ns.batches == [8]
 
 
+class TestBenchDefaultFlags:
+    """tools/bench_default_flags.py — the shared BENCH_DEFAULTS -> CLI
+    flags mapping both shell runbooks consume. Pin the mapping for a
+    fully-loaded defaults dict and the degraded no-file case."""
+
+    def _flags(self, tmp_path, defaults, with_batch):
+        import shutil
+        tools = tmp_path / "tools"
+        tools.mkdir(exist_ok=True)
+        shutil.copy("/root/repo/tools/bench_default_flags.py", tools)
+        if defaults is not None:
+            (tmp_path / "BENCH_DEFAULTS.json").write_text(
+                json.dumps(defaults))
+        spec = importlib.util.spec_from_file_location(
+            "bdf_mod", tools / "bench_default_flags.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.flags(with_batch)
+
+    def test_full_defaults_roundtrip(self, tmp_path):
+        flags = self._flags(tmp_path, {
+            "batches": [10, 8], "corr_dtype": "bfloat16",
+            "corr_impl": "softsel", "fused_loss": True, "scan_unroll": 2,
+        }, with_batch=True)
+        assert flags == ["--batch", "10", "--corr_dtype", "bfloat16",
+                         "--corr_impl", "softsel", "--fused_loss",
+                         "--scan_unroll", "2"]
+
+    def test_no_file_and_no_batch(self, tmp_path):
+        assert self._flags(tmp_path, None,
+                           with_batch=True) == ["--batch", "8"]
+        assert self._flags(tmp_path, None,
+                           with_batch=False) == []
+
+
 class TestScanUnrollPlumbing:
     def test_metric_tag_roundtrip(self, modules):
         _, pick = modules
