@@ -38,6 +38,10 @@ def main(argv=None):
     # the warmup loop's metrics; clamped below
     add_corr_args(p)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat_policy", "--remat-policy", default=None,
+                   choices=["full", "dots"],
+                   help="remat granularity (with --remat) — lets the "
+                        "trace match a remat bench default exactly")
     p.add_argument("--fused_loss", "--fused-loss", action="store_true",
                    help="trace the fused subpixel-domain loss path "
                         "(TrainConfig.fused_loss) so the profile matches "
@@ -56,6 +60,8 @@ def main(argv=None):
                                               make_train_step)
 
     overrides = corr_overrides(args)
+    if args.remat_policy:
+        overrides["remat_policy"] = args.remat_policy
     model_cfg = RAFTConfig(small=False, mixed_precision=not args.fp32,
                            remat=args.remat, **overrides)
     train_cfg = stage_config("chairs", batch_size=args.batch,

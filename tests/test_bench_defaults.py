@@ -258,6 +258,14 @@ class TestBenchDefaultFlags:
                          "--corr_impl", "softsel", "--fused_loss",
                          "--scan_unroll", "2"]
 
+    def test_remat_defaults_mapped(self, tmp_path):
+        # a remat ladder winner must trace as the remat step, not the
+        # plain one (profile_step grew --remat_policy for this)
+        flags = self._flags(tmp_path, {
+            "batches": [8], "remat": True, "remat_policy": "dots",
+        }, with_batch=False)
+        assert flags == ["--remat", "--remat_policy", "dots"]
+
     def test_no_file_and_no_batch(self, tmp_path):
         assert self._flags(tmp_path, None,
                            with_batch=True) == ["--batch", "8"]

@@ -31,6 +31,10 @@ def flags(with_batch: bool) -> list:
         out.append("--fused_loss")
     if d.get("scan_unroll", 1) != 1:
         out += ["--scan_unroll", str(d["scan_unroll"])]
+    if d.get("remat"):
+        out.append("--remat")
+        if d.get("remat_policy"):
+            out += ["--remat_policy", d["remat_policy"]]
     return out
 
 

@@ -58,11 +58,19 @@ rehearse bench_fused_unroll2 600 python bench.py --hw 64 64 --batches 2 \
     --steps 1 --warmup 1 --corr-dtype bfloat16 --no-remat --fused-loss \
     --scan-unroll 2
 
-# trained parity, tiny crop, both backends the runbook measures
+# trained parity, tiny crop, both backends the runbook measures — in a
+# COPY of the checkpoint dir: the tool writes its result JSONs (and
+# torch-flow cache) into --ckpt-dir, and the runbook copies those JSONs
+# out as *_onchip records; rehearsal CPU numbers must never be able to
+# masquerade as them (that corruption happened once — see the guarded
+# cp in onchip_round5.sh)
+DRESS_CKPT=/tmp/dress_ref_ckpt_r5
+rm -rf "$DRESS_CKPT"
+cp -r /root/.cache/raft_tpu/ref_ckpt "$DRESS_CKPT"
 rehearse parity_default 1200 python tools/trained_parity.py \
-    --hw 128 256 --iters 4
+    --hw 128 256 --iters 4 --ckpt-dir "$DRESS_CKPT"
 rehearse parity_softsel 1200 python tools/trained_parity.py \
-    --hw 128 256 --iters 4 --corr_impl softsel
+    --hw 128 256 --iters 4 --corr_impl softsel --ckpt-dir "$DRESS_CKPT"
 
 # serving rows
 rehearse infer_fp32 600 python -m raft_tpu.cli.infer_bench \

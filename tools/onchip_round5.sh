@@ -225,8 +225,13 @@ step infer_bf16_unroll2 2400 python -m raft_tpu.cli.infer_bench \
 # its speed; torch flows come from the r3 cache)
 step trained_parity_softsel 2400 python tools/trained_parity.py \
     --corr_impl softsel
-cp /root/.cache/raft_tpu/ref_ckpt/trained_parity_softsel.json \
-    /root/repo/TRAINED_PARITY_softsel_onchip.json 2>/dev/null || true
+# only a result the ON-CHIP step above actually produced may be labeled
+# _onchip (an unguarded cp here once published CPU rehearsal numbers
+# under this name — caught and reverted in r5)
+if [ -e "$MARK/trained_parity_softsel" ]; then
+    cp /root/.cache/raft_tpu/ref_ckpt/trained_parity_softsel.json \
+        /root/repo/TRAINED_PARITY_softsel_onchip.json 2>/dev/null || true
+fi
 # isolated softsel rows give the per-lookup story for BENCH_NOTES
 step s_bf16 1800 python -m raft_tpu.cli.corr_bench --batch 6 --hw 46 62 \
     --iters 20 --impls onehot softsel --grad --corr-dtype bfloat16
