@@ -29,5 +29,7 @@ while true; do
     else
         echo "$(date -u +%H:%M:%S) chip unavailable" >> "$LOG"
     fi
-    sleep 300
+    # 180s sleep + up-to-180s hung probe = ~6 min poll period while the
+    # tunnel is down; a fresh ~100-min window loses at most that
+    sleep 180
 done
