@@ -194,8 +194,12 @@ step infer_bf16_unroll2 2400 python -m raft_tpu.cli.infer_bench \
 # that measures its speed; torch flows come from the r3 cache)
 step trained_parity_softsel 2400 python tools/trained_parity.py \
     --corr_impl softsel
-cp /root/.cache/raft_tpu/ref_ckpt/trained_parity_softsel.json \
-    /root/repo/TRAINED_PARITY_softsel_onchip.json 2>/dev/null || true
+# guard added retroactively (r5): only an on-chip result may carry the
+# _onchip label — the unguarded cp once published CPU rehearsal numbers
+if [ -e "$MARK/trained_parity_softsel" ]; then
+    cp /root/.cache/raft_tpu/ref_ckpt/trained_parity_softsel.json \
+        /root/repo/TRAINED_PARITY_softsel_onchip.json 2>/dev/null || true
+fi
 
 # ---- 6. fresh trace at the current winner (next-bottleneck hunt) ------
 # profile exactly the config BENCH_DEFAULTS.json now pins
