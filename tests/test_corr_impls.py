@@ -360,3 +360,25 @@ class TestSoftselParity:
             outs[impl] = np.asarray(flow)
         np.testing.assert_allclose(outs["softsel"], outs["onehot"],
                                    atol=1e-4, rtol=1e-4)
+
+
+class TestInterpretFallback:
+    """Off-TPU, pallas_call must auto-fall back to interpret mode AND
+    warn loudly — an export/AOT trace on a CPU host would otherwise bake
+    the pure-XLA path into a TPU-bound artifact silently (round-5
+    review). No _INTERPRET monkeypatch here: this pins the fallback
+    path itself."""
+
+    def test_lookup_runs_and_warns_off_tpu(self, setup):
+        import warnings
+
+        pyramid, coords = setup
+        want = np.asarray(corr_lookup(pyramid, coords, RADIUS))
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            got = np.asarray(
+                corr_pallas.corr_lookup_pallas(pyramid, coords, RADIUS))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+        assert any("interpret mode" in str(w.message) for w in rec), (
+            "fallback must warn so exports can't silently ship the "
+            "pure-XLA path")
