@@ -38,17 +38,26 @@ class RAFTConfig:
     # kernel; interpret-mode fallback off-TPU). Accuracy at trained
     # weights is uniform across all five — basic max <=1.24e-5 px vs the
     # live torch reference, TRAINED_PARITY_backends.json (r5) — so
-    # backend choice is decided on speed alone. On-chip at chairs
-    # geometry (BENCH_NOTES.md r3, v5e-1, per lookup): gather 294 ms fwd
-    # (scatter lowering makes its backward disqualifying); onehot 10.8
-    # ms fwd / 14.0 fwd+grad; pallas 15.1 / 27.5 (losing in every regime
-    # measured so far — kept as the memory-regime insurance pending the
-    # serving-geometry row); onehot_t whole-step A/B'd a wash vs onehot
-    # (24.32 vs 24.23 pairs/s, ONCHIP_r03e.log — kept for its
-    # pixels-on-lanes layout, which spatial sharding prefers); softsel:
-    # tested fallback, no hardware number as of r5 (ladder row queued in
-    # tools/onchip_round5.sh). Re-benchmark with
-    # `python -m raft_tpu.cli.corr_bench` (+ --grad).
+    # backend choice is decided on speed alone. On-chip (v5e-1) status
+    # after the r5 ladder (ONCHIP_r05.log, 2026-08-01): softsel is the
+    # measured whole-step WINNER — 26.98 pairs/s alone, 27.99 composed
+    # with the fused loss, vs onehot's 24.99 at the same b8 chairs
+    # geometry — despite losing the isolated-lookup row (6.7 ms vs
+    # onehot 4.9, s_bf16): its lerp-as-GEMM form trades lookup time for
+    # a fusion/layout win across the whole step. Its trained-weights
+    # accuracy ON CHIP is pinned at basic max 1.2e-4 px / small 4.0e-4
+    # (TRAINED_PARITY_softsel_onchip.json). onehot is the isolated-
+    # lookup fastest and stays the library default (conservative;
+    # r3-pinned 10.8 ms fwd / 14.0 fwd+grad at chairs geometry) — the
+    # bench/trainer reach softsel via BENCH_DEFAULTS.json. gather: 294 ms
+    # fwd r3, scatter backward disqualifying. onehot_t: whole-step wash
+    # vs onehot (24.32 vs 24.23, ONCHIP_r03e.log — kept for its
+    # pixels-on-lanes layout, which spatial sharding prefers). pallas:
+    # lost its last hypothesized regime on 2026-08-01 — serving geometry
+    # 55x128 b1: 8.57 ms vs onehot 5.41 (pallas_regime row) on top of
+    # r3's 15.1/27.5 vs 10.8/14.0 — DEMOTED to documented insurance for
+    # memory-constrained shapes; not reachable from any default.
+    # Re-benchmark with `python -m raft_tpu.cli.corr_bench` (+ --grad).
     corr_impl: str = "onehot"
     # storage dtype of the materialized correlation pyramid. The reference
     # computes correlation in an fp32 island (core/raft.py:102-103) and so
@@ -89,9 +98,15 @@ class RAFTConfig:
     # iteration body so XLA can software-pipeline across iteration
     # boundaries (overlap iteration i's GRU convs with i+1's lookup
     # GEMMs) at the cost of unroll x compile time and code size. Math is
-    # identical for any value (pinned in tests/test_model.py). No
-    # hardware number as of r4 — ladder row queued in
-    # tools/onchip_round4.sh.
+    # identical for any value (pinned in tests/test_model.py). Measured
+    # on chip 2026-08-01 (ONCHIP_r05.log), direction depends on the
+    # pass structure: TRAINING NEGATIVE — unroll2 21.7 pairs/s vs 24.99
+    # at unroll1 (b8 chairs), composed fused+softsel+unroll4 26.98 vs
+    # 27.99 — the replicated body plus its saved residuals blow the
+    # VMEM/code budget instead of pipelining. SERVING POSITIVE —
+    # forward-only 440x1024 iters20 bf16: 54.8 ms at unroll2 vs 59.1 at
+    # unroll1 (-7%), no backward residuals to hold. Keep 1 for train;
+    # serving CLIs may pass --scan_unroll 2.
     scan_unroll: int = 1
 
     def __post_init__(self):
