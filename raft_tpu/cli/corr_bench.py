@@ -165,10 +165,25 @@ def main(argv=None):
                 return _fn(vols, c)
         lookups[name] = (run, vols)
 
+    # Known-crashing cell (CRASH_BISECT_r05.log): gather's bf16 backward
+    # (scatter lowering) takes down the TPU worker, and a dead worker
+    # fails every impl queued after it in the same process — the r3
+    # shootout lost its onehot/onehot_t rows exactly this way. Warn and
+    # run gather LAST so one crashing backend can't invalidate the rest.
+    run_order = list(args.impls)
+    if (args.grad and args.corr_dtype == "bfloat16" and "gather" in run_order
+            and len(run_order) > 1):
+        import warnings
+        warnings.warn(
+            "gather+grad+bfloat16 is a known TPU-worker-crashing cell "
+            "(CRASH_BISECT_r05.log); reordering it last so the other "
+            "impls' rows land first", stacklevel=1)
+        run_order = [n for n in run_order if n != "gather"] + ["gather"]
+
     reference = None
     results = {}
     failed = []
-    for name in args.impls:
+    for name in run_order:
         if name not in impls:
             print(f"{name:>8}: unknown impl (choose from "
                   f"{', '.join(impls)})")
