@@ -72,6 +72,10 @@ def main(argv=None):
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--step-pairs-per-sec", type=float, default=None,
                    help="measured TPU step throughput to compare against")
+    p.add_argument("--wire-dtype", default="uint8",
+                   choices=["uint8", "float32"],
+                   help="collate wire format; defaults to uint8, what the "
+                        "trainer ships (data/loader._collate)")
     args = p.parse_args(argv)
 
     with tempfile.TemporaryDirectory() as root:
@@ -82,7 +86,8 @@ def main(argv=None):
 
         ds = build_dataset(root)
         loader = PrefetchLoader(ds, args.batch, num_workers=args.workers,
-                                clamp=not args.no_clamp)
+                                clamp=not args.no_clamp,
+                                wire_dtype=args.wire_dtype)
 
         # warm epoch (page cache, thread spin-up), then timed epochs
         for _ in loader:

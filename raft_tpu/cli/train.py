@@ -127,7 +127,7 @@ def _synthetic_loader(n: int, train_cfg):
     ds = build_dataset(root, crop=train_cfg.image_size)
     return PrefetchLoader(ds, train_cfg.batch_size,
                           num_workers=train_cfg.num_workers,
-                          seed=train_cfg.seed)
+                          seed=train_cfg.seed, wire_dtype="uint8")
 
 
 if __name__ == "__main__":

@@ -21,8 +21,39 @@ from typing import Dict, Iterator, Optional
 import numpy as np
 
 
-def _collate(samples) -> Dict[str, np.ndarray]:
+def _collate(samples, wire_dtype: str = "float32",
+             check: bool = False) -> Dict[str, np.ndarray]:
     img1, img2, flow, valid = zip(*samples)
+    if wire_dtype == "uint8":
+        # Low-bandwidth wire format: images and valid travel as uint8 and
+        # the jitted train step casts them back on device. Lossless by the
+        # augmentor contract — every augmentation runs on uint8 images and
+        # the final float32 astype only widens (augmentor.py), and valid is
+        # a 0/1 mask — while cutting host->device bytes 50 -> 19 MB per
+        # chairs-b8 batch. Measured on the round-5 tunnel backend (axon,
+        # where in-flight H2D crawls at ~60 MB/s): 1228 -> 606 ms/step
+        # (BENCH_NOTES.md round 5). flow is real-valued ground truth and
+        # stays float32. Cast per sample BEFORE the stack so the full-size
+        # float32 batch never materializes on the loader thread.
+        if check:
+            for name, s in (("image1", img1[0]), ("image2", img2[0])):
+                s = np.asarray(s)
+                if not (s.min() >= 0 and s.max() <= 255
+                        and np.array_equal(s, np.floor(s))):
+                    raise ValueError(
+                        "wire_dtype='uint8' requires integral [0,255] "
+                        f"images (the augmentor contract) — {name} has "
+                        f"values in [{s.min():.3g}, {s.max():.3g}]; use "
+                        "wire_dtype='float32' for this dataset")
+            v = np.asarray(valid[0])
+            if not np.isin(v, (0.0, 1.0)).all():
+                raise ValueError(
+                    "wire_dtype='uint8' requires a 0/1 valid mask — got "
+                    f"values in [{v.min():.3g}, {v.max():.3g}] (fractional "
+                    "weights would be truncated); use wire_dtype='float32'")
+        img1 = [np.asarray(x, np.uint8) for x in img1]
+        img2 = [np.asarray(x, np.uint8) for x in img2]
+        valid = [np.asarray(v, np.uint8) for v in valid]
     return {
         "image1": np.stack(img1),
         "image2": np.stack(img2),
@@ -36,10 +67,15 @@ class PrefetchLoader:
 
     def __init__(self, dataset, batch_size: int, shuffle: bool = True,
                  num_workers: int = 4, drop_last: bool = True,
-                 seed: int = 1234, prefetch: int = 4, clamp: bool = True):
+                 seed: int = 1234, prefetch: int = 4, clamp: bool = True,
+                 wire_dtype: str = "float32"):
+        if wire_dtype not in ("float32", "uint8"):
+            raise ValueError(f"wire_dtype={wire_dtype!r}: choose float32 "
+                             "or uint8 (see _collate)")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
+        self.wire_dtype = wire_dtype
         # clamp to the host: more worker threads than spare cores only
         # buys GIL/queue contention (measured on the 1-core deployment
         # host: 1 worker 52.2 pairs/s vs 4 workers 44.6, cli/loader_bench;
@@ -106,7 +142,9 @@ class PrefetchLoader:
                     return
                 try:
                     batch = _collate([self.dataset[int(i)]
-                                      for i in batch_idx])
+                                      for i in batch_idx],
+                                     self.wire_dtype,
+                                     check=(bi == 0))
                 except Exception as e:  # surface decode errors to consumer
                     batch = e
                 with cond:
@@ -135,11 +173,19 @@ class PrefetchLoader:
 
 def fetch_dataloader(stage: str, image_size, batch_size: int,
                      data_root: str = "datasets", num_workers: int = 4,
-                     seed: int = 1234) -> PrefetchLoader:
-    """Stage-preset loader, the fetch_dataloader analog (datasets.py:199)."""
+                     seed: int = 1234,
+                     wire_dtype: str = "float32") -> PrefetchLoader:
+    """Stage-preset loader, the fetch_dataloader analog (datasets.py:199).
+
+    Default stays float32 (the stable public contract — batches safe for
+    host arithmetic); the in-repo trainer passes ``wire_dtype="uint8"``
+    explicitly for the low-bandwidth wire format the jitted step casts
+    back on device (see _collate).
+    """
     from raft_tpu.data.datasets import fetch_dataset
 
     dataset = fetch_dataset(stage, image_size, data_root)
     print(f"Training with {len(dataset)} image pairs")
     return PrefetchLoader(dataset, batch_size, shuffle=True,
-                          num_workers=num_workers, drop_last=True, seed=seed)
+                          num_workers=num_workers, drop_last=True, seed=seed,
+                          wire_dtype=wire_dtype)

@@ -71,8 +71,12 @@ def make_train_step(model_cfg: RAFTConfig, train_cfg: TrainConfig):
     """Build the jittable (state, batch, rng) -> (state, metrics) step.
 
     batch: dict with image1/image2 (B,H,W,3), flow (B,H,W,2), valid (B,H,W).
-    Gaussian image noise (train.py:167-170) is applied on-device when
-    ``train_cfg.add_noise``.
+    image1/image2/valid may arrive uint8 (the loader's low-bandwidth wire
+    format) or float32 — the step casts on device. ``rng`` is a BASE key,
+    constant across the run: the step derives its per-step key as
+    ``fold_in(rng, state.step)``, so callers pass the same key every step
+    and a resumed run reproduces the stream. Gaussian image noise
+    (train.py:167-170) is applied on-device when ``train_cfg.add_noise``.
     """
     model = RAFT(model_cfg)
     freeze_bn = train_cfg.stage != "chairs"  # train.py:147-148
@@ -92,7 +96,19 @@ def make_train_step(model_cfg: RAFTConfig, train_cfg: TrainConfig):
 
     def train_step(state: RAFTTrainState, batch: Dict[str, jax.Array],
                    rng: jax.Array):
-        image1, image2 = batch["image1"], batch["image2"]
+        # Per-step key derived INSIDE the jitted step from the base key and
+        # the step counter. Two wins over a host-side split chain: (a) a
+        # resumed run replays the exact key sequence from state.step without
+        # replaying the chain; (b) no per-step host dispatch — on the
+        # round-5 remote tunnel a host jax.random.split between steps cost
+        # ~730 ms/step of lost pipelining (BENCH_NOTES.md round 5).
+        rng = jax.random.fold_in(rng, state.step)
+        # Wire-format cast: accept uint8 images/valid from the loader's
+        # low-bandwidth wire (lossless — see data/loader._collate) as well
+        # as float32; the cast is a no-op for float32 inputs.
+        image1 = batch["image1"].astype(jnp.float32)
+        image2 = batch["image2"].astype(jnp.float32)
+        valid = batch["valid"].astype(jnp.float32)
         if train_cfg.add_noise:
             rng, k0, k1, k2 = jax.random.split(rng, 4)
             stdv = jax.random.uniform(k0, (), minval=0.0, maxval=5.0)
@@ -123,7 +139,7 @@ def make_train_step(model_cfg: RAFTConfig, train_cfg: TrainConfig):
                 preds, new_bs = out, state.batch_stats
             loss_impl = sequence_loss_subpixel if fused else sequence_loss
             loss, metrics = loss_impl(
-                preds, batch["flow"], batch["valid"], train_cfg.gamma)
+                preds, batch["flow"], valid, train_cfg.gamma)
             return loss, (metrics, new_bs)
 
         (loss, (metrics, new_bs)), grads = jax.value_and_grad(

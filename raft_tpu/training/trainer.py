@@ -93,7 +93,7 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
         loader = fetch_dataloader(
             train_cfg.stage, train_cfg.image_size, train_cfg.batch_size,
             data_root=train_cfg.data_root, num_workers=train_cfg.num_workers,
-            seed=train_cfg.seed)
+            seed=train_cfg.seed, wire_dtype="uint8")
 
     mesh = make_mesh()
     step_fn = jax.jit(make_train_step(model_cfg, train_cfg),
@@ -150,8 +150,10 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
                     jax.profiler.start_trace(
                         os.path.join(train_cfg.log_dir, train_cfg.name))
                     profiling = True
-                rng, step_rng = jax.random.split(rng)
-                state, metrics = step_fn(state, sharded, step_rng)
+                # constant base key: the step fold_ins state.step itself
+                # (a host-side split here cost ~730 ms/step of pipelining
+                # on the remote tunnel — BENCH_NOTES.md round 5)
+                state, metrics = step_fn(state, sharded, rng)
                 if profiling and total_steps >= prof[1]:
                     jax.block_until_ready(metrics)
                     jax.profiler.stop_trace()

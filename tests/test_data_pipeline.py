@@ -231,6 +231,45 @@ class TestPrefetchLoader:
         with pytest.raises(ValueError, match="boom"):
             list(PrefetchLoader(Bad(4), 2, num_workers=2))
 
+    def test_uint8_wire_dtypes_and_losslessness(self):
+        ds = self.TinyDataset(6)
+        f32 = next(iter(PrefetchLoader(ds, 2, seed=5, num_workers=1)))
+        u8 = next(iter(PrefetchLoader(ds, 2, seed=5, num_workers=1,
+                                      wire_dtype="uint8")))
+        assert u8["image1"].dtype == np.uint8
+        assert u8["valid"].dtype == np.uint8
+        assert u8["flow"].dtype == np.float32  # real-valued GT stays f32
+        # integral-valued images survive the wire exactly
+        np.testing.assert_array_equal(
+            u8["image1"].astype(np.float32), f32["image1"])
+        np.testing.assert_array_equal(
+            u8["valid"].astype(np.float32), f32["valid"])
+
+    def test_wire_dtype_validated(self):
+        with pytest.raises(ValueError, match="wire_dtype"):
+            PrefetchLoader(self.TinyDataset(4), 2, wire_dtype="int4")
+
+    def test_uint8_wire_rejects_nonintegral_images(self):
+        class FloatImages(self.TinyDataset):
+            def __getitem__(self, i):
+                x = np.full((2, 2, 3), 0.5, np.float32)  # normalized [0,1]
+                return x, x, np.zeros((2, 2, 2), np.float32), np.ones(
+                    (2, 2), np.float32)
+
+        with pytest.raises(ValueError, match="integral"):
+            list(PrefetchLoader(FloatImages(4), 2, num_workers=1,
+                                wire_dtype="uint8"))
+
+    def test_uint8_wire_rejects_fractional_valid(self):
+        class SoftValid(self.TinyDataset):
+            def __getitem__(self, i):
+                img1, img2, flow, _ = super().__getitem__(i)
+                return img1, img2, flow, np.full((2, 2), 0.7, np.float32)
+
+        with pytest.raises(ValueError, match="valid mask"):
+            list(PrefetchLoader(SoftValid(4), 2, num_workers=1,
+                                wire_dtype="uint8"))
+
 
 class TestFlowViz:
     def test_colorwheel_layout(self):
