@@ -103,10 +103,10 @@ class RAFTConfig:
     # pass structure: TRAINING NEGATIVE — unroll2 21.7 pairs/s vs 24.99
     # at unroll1 (b8 chairs), composed fused+softsel+unroll4 26.98 vs
     # 27.99 — the replicated body plus its saved residuals blow the
-    # VMEM/code budget instead of pipelining. SERVING POSITIVE —
-    # forward-only 440x1024 iters20 bf16: 54.8 ms at unroll2 vs 59.1 at
-    # unroll1 (-7%), no backward residuals to hold. Keep 1 for train;
-    # serving CLIs may pass --scan_unroll 2.
+    # VMEM/code budget instead of pipelining. Serving looked positive
+    # pre-rework (54.8 ms at unroll2 vs 59.1), but after the upsampler
+    # shift-mulacc rework it is a wash (54.8 vs 55.0) — the unroll had
+    # been hiding upsampler latency that no longer exists. Keep 1.
     scan_unroll: int = 1
 
     def __post_init__(self):

@@ -63,25 +63,17 @@ def convex_upsample(flow: jax.Array, mask: jax.Array) -> jax.Array:
     matching ``F.unfold(8*flow, [3,3], padding=1)``. Output pixel
     (8h+i, 8w+j) = sum_k softmax(mask)[k,i,j] * 8*flow[h+dy_k, w+dx_k].
     """
+    # One-frame view of the lane-tiled batched form (identical math: the
+    # (9,64) factoring of the 576 channels is the (9,8,8) factoring with
+    # (i,j) flattened, and the softmax runs over the same 9 axis in fp32 —
+    # the convex combination stays an fp32 island as the reference computes
+    # it outside autocast). The previous per-frame stacked-neighborhood
+    # einsum hit the same TPU pathology measured for the batched path
+    # (see the measurement note in convex_upsample_batched_raw): tiny
+    # k=9 contraction, large layout copies.
     B, H, W, _ = flow.shape
-    mask = mask.reshape(B, H, W, 9, 8, 8).astype(jnp.float32)
-    mask = jax.nn.softmax(mask, axis=3)
-
-    # 3x3 neighborhood of 8*flow, zero-padded (F.unfold pads with zeros).
-    fp = jnp.pad(8.0 * flow.astype(jnp.float32),
-                 ((0, 0), (1, 1), (1, 1), (0, 0)))
-    neighbors = jnp.stack(
-        [fp[:, dy:dy + H, dx:dx + W, :] for dy in range(3) for dx in range(3)],
-        axis=3,
-    )  # (B, H, W, 9, 2)
-
-    # fp32 island: default matmul precision is bf16-class on TPU; the convex
-    # combination must stay exact (reference computes it outside autocast).
-    up = jnp.einsum("bhwkij,bhwkc->bhwijc", mask, neighbors,
-                    precision=jax.lax.Precision.HIGHEST)
-    # (B, H, W, 8, 8, 2) -> (B, 8H, 8W, 2)
-    up = up.transpose(0, 1, 3, 2, 4, 5)
-    return up.reshape(B, 8 * H, 8 * W, 2)
+    return subpixel_to_standard(
+        convex_upsample_batched_raw(flow[None], mask[None]), H, W)[0]
 
 
 def convex_upsample_batched(flow: jax.Array, mask: jax.Array) -> jax.Array:
@@ -125,20 +117,21 @@ def convex_upsample_batched_raw(flow: jax.Array,
     m = m.transpose(0, 1, 3, 4, 2)
     w9 = jax.nn.softmax(m, axis=2)
 
-    # 3x3 neighborhood of 8*flow, zero-padded -> (T,B,2,9,HW)
+    # Convex combination as 9 shifted multiply-accumulates instead of a
+    # stacked-neighborhood einsum: the k=9 "GEMM" contraction is tiny, so
+    # dot_general buys no MXU win but forces the (T,B,2,9,HW) neighbor
+    # stack plus layout copies of the 630 MB weight tensor around it.
+    # Measured on chip (round 5, isolated fwd+bwd at chairs-b8 geometry):
+    # einsum form 1176 ms, this form 28 ms; identical values (the k-sum
+    # runs in fp32 either way).
     fp = jnp.pad(8.0 * flow.astype(jnp.float32),
                  ((0, 0), (0, 0), (1, 1), (1, 1), (0, 0)))
-    nb = jnp.stack(
-        [fp[:, :, dy:dy + H, dx:dx + W, :]
-         for dy in range(3) for dx in range(3)],
-        axis=2,
-    )  # (T, B, 9, H, W, 2)
-    nb = nb.transpose(0, 1, 5, 2, 3, 4).reshape(T, B, 2, 9, HW)
-
-    # out[t,b,c,s,n] = sum_k w9[t,b,k,s,n] * nb[t,b,c,k,n]; minor dims of
-    # every operand/result are (64-multiple, HW) — lane-clean
-    up = jnp.einsum("tbksn,tbckn->tbcsn", w9, nb,
-                    precision=jax.lax.Precision.HIGHEST)
+    up = jnp.zeros((T, B, 2, 64, HW), jnp.float32)
+    for k, (dy, dx) in enumerate((dy, dx) for dy in range(3)
+                                 for dx in range(3)):
+        sh = fp[:, :, dy:dy + H, dx:dx + W, :]            # (T,B,H,W,2)
+        sh = sh.transpose(0, 1, 4, 2, 3).reshape(T, B, 2, 1, HW)
+        up = up + w9[:, :, k][:, :, None] * sh
     return up  # (T, B, 2, 64, H*W); subpixel s = 8i + j, n = W*h + w
 
 
