@@ -256,7 +256,7 @@ def _build_parser(suppress=False):
     p.add_argument("--deadline-s", type=float, default=default(2400.0),
                    help="no new attempt starts after this wall-clock budget")
     p.add_argument("--corr-impl", default=default(None),
-                   choices=["gather", "onehot", "onehot_t", "softsel", "pallas"],
+                   choices=["gather", "onehot", "onehot_t", "softsel", "softsel_t", "pallas"],
                    help="override RAFTConfig.corr_impl")
     p.add_argument("--fused-loss", action=argparse.BooleanOptionalAction,
                    default=default(False),

@@ -16,7 +16,7 @@ import argparse
 
 def add_corr_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--corr_impl", "--corr-impl", default=None,
-                   choices=["gather", "onehot", "onehot_t", "softsel", "pallas"],
+                   choices=["gather", "onehot", "onehot_t", "softsel", "softsel_t", "pallas"],
                    help="lookup backend override (default: RAFTConfig's)")
     p.add_argument("--corr_dtype", "--corr-dtype", default=None,
                    choices=["float32", "bfloat16"],

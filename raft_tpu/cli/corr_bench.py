@@ -77,7 +77,8 @@ def main(argv=None):
                                       build_corr_pyramid_t, corr_lookup,
                                       corr_lookup_onehot,
                                       corr_lookup_onehot_t,
-                                      corr_lookup_softsel)
+                                      corr_lookup_softsel,
+                                      corr_lookup_softsel_t)
     from raft_tpu.ops.pooling import avg_pool2x2
 
     B, (H, W), C = args.batch, args.hw, args.dim
@@ -123,7 +124,7 @@ def main(argv=None):
     pyramid_t = (jax.block_until_ready(tuple(
         v.astype(args.corr_dtype) for v in
         build_corr_pyramid_t(fmap1, fmap2, args.levels)))
-        if "onehot_t" in args.impls else None)
+        if {"onehot_t", "softsel_t"} & set(args.impls) else None)
 
     # per impl: (volume input to differentiate, lookup fn, grad postprocess)
     impls = {
@@ -137,6 +138,9 @@ def main(argv=None):
         "onehot_t": (pyramid_t,
                      lambda v, c: corr_lookup_onehot_t(v, c, args.radius),
                      transpose_grads),
+        "softsel_t": (pyramid_t,
+                      lambda v, c: corr_lookup_softsel_t(v, c, args.radius),
+                      transpose_grads),
         "pallas": (pyramid_pp,
                    lambda v, c: corr_lookup_pallas(
                        v, c, args.radius, prepadded=True), unpad_grads),

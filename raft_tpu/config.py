@@ -52,7 +52,12 @@ class RAFTConfig:
     # bench/trainer reach softsel via BENCH_DEFAULTS.json. gather: 294 ms
     # fwd r3, scatter backward disqualifying. onehot_t: whole-step wash
     # vs onehot (24.32 vs 24.23, ONCHIP_r03e.log — kept for its
-    # pixels-on-lanes layout, which spatial sharding prefers). pallas:
+    # pixels-on-lanes layout, which spatial sharding prefers).
+    # softsel_t (softsel's lerp fold on that transposed layout): isolated
+    # lookup identical to softsel (6.76 vs 6.77 ms fwd+grad bf16),
+    # whole-step single-chip NEGATIVE at chairs (31.39 vs 32.26,
+    # 2026-08-01) — kept, like onehot_t, for the spatial-sharding regime
+    # where the N-minor layout is the one that shards cleanly. pallas:
     # lost its last hypothesized regime on 2026-08-01 — serving geometry
     # 55x128 b1: 8.57 ms vs onehot 5.41 (pallas_regime row) on top of
     # r3's 15.1/27.5 vs 10.8/14.0 — DEMOTED to documented insurance for
@@ -115,12 +120,14 @@ class RAFTConfig:
                 and self.scan_unroll >= 1):
             raise ValueError(
                 f"scan_unroll={self.scan_unroll!r}: must be an int >= 1")
-        if self.corr_impl not in ("gather", "onehot", "onehot_t", "softsel", "pallas"):
+        if self.corr_impl not in ("gather", "onehot", "onehot_t", "softsel",
+                                  "softsel_t", "pallas"):
             raise ValueError(
                 f"corr_impl={self.corr_impl!r}: choose gather, onehot, "
-                "onehot_t, softsel, or pallas (the memory-efficient alternate path "
-                "is selected by alternate_corr=True, with corr_impl "
-                "picking its XLA/pallas backend)")
+                "onehot_t, softsel, softsel_t, or pallas (the "
+                "memory-efficient alternate path is selected by "
+                "alternate_corr=True, with corr_impl picking its "
+                "XLA/pallas backend)")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy={self.remat_policy!r}: choose 'full' or "

@@ -318,6 +318,57 @@ def corr_lookup_onehot_t(pyramid_t: Sequence[jax.Array], coords: jax.Array,
     return jnp.concatenate(out, axis=-1).reshape(B, H, W, -1)
 
 
+def corr_lookup_softsel_t(pyramid_t: Sequence[jax.Array], coords: jax.Array,
+                          radius: int) -> jax.Array:
+    """:func:`corr_lookup_softsel`'s lerp-folded soft two-hot selection
+    composed with :func:`corr_lookup_onehot_t`'s TRANSPOSED
+    (pixels-on-lanes) volume layout.
+
+    Motivation (XProf, round 5): at the r5 ladder winner the softsel
+    selection GEMMs and their backwards were ~30% of the train step —
+    their (B, N, K, Wl) intermediates tile the (8,128) memory tile at
+    ~27% occupancy (20-80 GB/s effective). Here every selection operand,
+    intermediate, and the volume itself keep the query index N minor
+    (lane-clean), while the bilinear lerp still rides inside the
+    selection GEMMs with no lerp intermediates. Same math as softsel;
+    same zeros-for-out-of-range semantics.
+    """
+    B, H, W, _ = coords.shape
+    N = H * W
+    K = 2 * radius + 1
+    x = coords[..., 0].reshape(B, N).astype(jnp.float32)
+    y = coords[..., 1].reshape(B, N).astype(jnp.float32)
+
+    out = []
+    for i, vol in enumerate(pyramid_t):
+        Hl, Wl = vol.shape[1:3]
+        x0, y0, wx, wy = _window_base(x / (2 ** i), y / (2 ** i), radius)
+        taps = jnp.arange(K, dtype=jnp.int32)
+        rows = jnp.swapaxes(y0[..., None] + taps, 1, 2)   # (B, K, N)
+        cols = jnp.swapaxes(x0[..., None] + taps, 1, 2)
+        fp32_vol = vol.dtype == jnp.float32
+        sel_dtype = jnp.float32 if fp32_vol else vol.dtype
+        prec = HIGHEST if fp32_vol else None
+        ih = jnp.arange(Hl)[:, None]
+        iw = jnp.arange(Wl)[:, None]
+        wy_ = wy[:, None, None, :]                        # (B, 1, 1, N)
+        wx_ = wx[:, None, None, :]
+        r_ = rows[:, :, None, :]                          # (B, K, 1, N)
+        c_ = cols[:, :, None, :]
+        sel_y = ((1.0 - wy_) * (r_ == ih)
+                 + wy_ * (r_ + 1 == ih)).astype(sel_dtype)  # (B, K, Hl, N)
+        sel_x = ((1.0 - wx_) * (c_ == iw)
+                 + wx_ * (c_ + 1 == iw)).astype(sel_dtype)
+        tmp = jnp.einsum("bkhn,bhwn->bkwn", sel_y, vol,
+                         precision=prec)                  # row select+lerp
+        win = jnp.einsum("bqwn,bkwn->bkqn", sel_x, tmp,
+                         precision=prec)                  # col select+lerp
+        # (B, Ky, Kx, N) -> x-major flat channels
+        out.append(jnp.transpose(win.astype(jnp.float32), (0, 3, 2, 1))
+                   .reshape(B, N, K * K))
+    return jnp.concatenate(out, axis=-1).reshape(B, H, W, -1)
+
+
 def _separable_lerp_t(win: jax.Array, wx: jax.Array, wy: jax.Array,
                       radius: int) -> jax.Array:
     """(B, P, P, N) [y, x] window -> (B, N, K²) x-major channels."""

@@ -35,6 +35,7 @@ from raft_tpu.models.corr import (
     corr_lookup_onehot,
     corr_lookup_onehot_t,
     corr_lookup_softsel,
+    corr_lookup_softsel_t,
 )
 from raft_tpu.models.encoders import BasicEncoder, SmallEncoder
 from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
@@ -122,14 +123,17 @@ class RAFT(nn.Module):
                     f1, f2_pyr = state
                     return alt_corr_lookup(f1, f2_pyr, coords,
                                            cfg.corr_radius)
-        elif cfg.corr_impl == "onehot_t":
+        elif cfg.corr_impl in ("onehot_t", "softsel_t"):
             # transposed (pixels-on-lanes) volume — see build_corr_pyramid_t
             corr_state = tuple(
                 v.astype(cfg.corr_dtype)
                 for v in build_corr_pyramid_t(fmap1, fmap2, cfg.corr_levels))
+            lookup_t = (corr_lookup_softsel_t
+                        if cfg.corr_impl == "softsel_t"
+                        else corr_lookup_onehot_t)
 
             def lookup(state, coords):
-                return corr_lookup_onehot_t(state, coords, cfg.corr_radius)
+                return lookup_t(state, coords, cfg.corr_radius)
         else:
             corr_state = tuple(
                 v.astype(cfg.corr_dtype)

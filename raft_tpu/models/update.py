@@ -13,7 +13,7 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from raft_tpu.models.layers import TorchConv
+from raft_tpu.models.layers import TorchConv, fused_conv_pair
 
 
 class FlowHead(nn.Module):
@@ -40,10 +40,14 @@ class ConvGRU(nn.Module):
     @nn.compact
     def __call__(self, h, x):
         hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(TorchConv(self.hidden_dim, (3, 3), (1, 1), (1, 1),
-                                 self.dtype, name="convz")(hx))
-        r = nn.sigmoid(TorchConv(self.hidden_dim, (3, 3), (1, 1), (1, 1),
-                                 self.dtype, name="convr")(hx))
+        # z and r read the same hx: one double-width conv (identical
+        # values, params stay separate — see fused_conv_pair)
+        zl, rl = fused_conv_pair(
+            TorchConv(self.hidden_dim, (3, 3), (1, 1), (1, 1),
+                      self.dtype, name="convz"),
+            TorchConv(self.hidden_dim, (3, 3), (1, 1), (1, 1),
+                      self.dtype, name="convr"), hx)
+        z, r = nn.sigmoid(zl), nn.sigmoid(rl)
         q = nn.tanh(TorchConv(self.hidden_dim, (3, 3), (1, 1), (1, 1),
                               self.dtype, name="convq")(
             jnp.concatenate([r * h, x], axis=-1)))
@@ -58,12 +62,16 @@ class SepConvGRU(nn.Module):
 
     @nn.compact
     def __call__(self, h, x):
+        # z/r of each direction share their input hx: run each pair as
+        # one double-width conv (identical values, see fused_conv_pair)
         # horizontal (1x5)
         hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(TorchConv(self.hidden_dim, (1, 5), (1, 1), (0, 2),
-                                 self.dtype, name="convz1")(hx))
-        r = nn.sigmoid(TorchConv(self.hidden_dim, (1, 5), (1, 1), (0, 2),
-                                 self.dtype, name="convr1")(hx))
+        zl, rl = fused_conv_pair(
+            TorchConv(self.hidden_dim, (1, 5), (1, 1), (0, 2),
+                      self.dtype, name="convz1"),
+            TorchConv(self.hidden_dim, (1, 5), (1, 1), (0, 2),
+                      self.dtype, name="convr1"), hx)
+        z, r = nn.sigmoid(zl), nn.sigmoid(rl)
         q = nn.tanh(TorchConv(self.hidden_dim, (1, 5), (1, 1), (0, 2),
                               self.dtype, name="convq1")(
             jnp.concatenate([r * h, x], axis=-1)))
@@ -71,10 +79,12 @@ class SepConvGRU(nn.Module):
 
         # vertical (5x1)
         hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(TorchConv(self.hidden_dim, (5, 1), (1, 1), (2, 0),
-                                 self.dtype, name="convz2")(hx))
-        r = nn.sigmoid(TorchConv(self.hidden_dim, (5, 1), (1, 1), (2, 0),
-                                 self.dtype, name="convr2")(hx))
+        zl, rl = fused_conv_pair(
+            TorchConv(self.hidden_dim, (5, 1), (1, 1), (2, 0),
+                      self.dtype, name="convz2"),
+            TorchConv(self.hidden_dim, (5, 1), (1, 1), (2, 0),
+                      self.dtype, name="convr2"), hx)
+        z, r = nn.sigmoid(zl), nn.sigmoid(rl)
         q = nn.tanh(TorchConv(self.hidden_dim, (5, 1), (1, 1), (2, 0),
                               self.dtype, name="convq2")(
             jnp.concatenate([r * h, x], axis=-1)))

@@ -362,6 +362,54 @@ class TestSoftselParity:
                                    atol=1e-4, rtol=1e-4)
 
 
+class TestSoftselTParity:
+    """softsel_t = softsel's lerp-folded selections on the transposed
+    pixels-on-lanes volume (corr_lookup_softsel_t docstring)."""
+
+    def test_matches_gather(self, setup):
+        from raft_tpu.models.corr import corr_lookup_softsel_t
+
+        pyramid, coords = setup
+        pyr_t = [jnp.transpose(v, (0, 2, 3, 1)) for v in pyramid]
+        want = np.asarray(corr_lookup(pyramid, coords, RADIUS))
+        got = np.asarray(corr_lookup_softsel_t(pyr_t, coords, RADIUS))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_grad_matches_gather(self, setup):
+        from raft_tpu.models.corr import corr_lookup_softsel_t
+
+        pyramid, coords = setup
+        pyr_t = [jnp.transpose(v, (0, 2, 3, 1)) for v in pyramid]
+        g_want = jax.grad(
+            lambda p: jnp.sum(corr_lookup(p, coords, RADIUS) ** 2)
+        )(list(pyramid))
+        g_got = jax.grad(
+            lambda p: jnp.sum(corr_lookup_softsel_t(p, coords, RADIUS) ** 2)
+        )(list(pyr_t))
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b).transpose(0, 2, 3, 1),
+                atol=1e-4, rtol=1e-4)
+
+    def test_model_forward_same_flow(self):
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.models import RAFT
+
+        rng = np.random.RandomState(5)
+        i1 = jnp.asarray(rng.rand(1, 32, 48, 3).astype(np.float32) * 255)
+        i2 = jnp.asarray(rng.rand(1, 32, 48, 3).astype(np.float32) * 255)
+        outs = {}
+        for impl in ("onehot", "softsel_t"):
+            cfg = RAFTConfig(small=True, corr_impl=impl)
+            variables = RAFT(cfg).init(jax.random.PRNGKey(0), i1, i2,
+                                       iters=1)
+            _, flow = RAFT(cfg).apply(variables, i1, i2, iters=3,
+                                      test_mode=True)
+            outs[impl] = np.asarray(flow)
+        np.testing.assert_allclose(outs["softsel_t"], outs["onehot"],
+                                   atol=1e-4, rtol=1e-4)
+
+
 class TestInterpretFallback:
     """Off-TPU, pallas_call must auto-fall back to interpret mode AND
     warn loudly — an export/AOT trace on a CPU host would otherwise bake
