@@ -21,8 +21,14 @@
 set -eu
 cd /root/repo
 
-if [ "${1:-}" = "--selftest" ]; then
-    export PYTHONPATH= JAX_PLATFORMS=cpu
+if [ "${1:-}" = "--selftest" ] || [ "${1:-}" = "--selftest-tpu" ]; then
+    # --selftest runs CPU-safe (works while the tunnel is down);
+    # --selftest-tpu runs the identical pipeline on the live chip
+    # (proven 2026-08-01: convert -> validate_sintel -> 3-step train leg
+    # all green on the v5e-1, BENCH_NOTES round 5)
+    if [ "${1:-}" = "--selftest" ]; then
+        export PYTHONPATH= JAX_PLATFORMS=cpu
+    fi
     DATA=/tmp/raft_accept_data
     MODELS=/tmp/raft_accept_models
     rm -rf "$DATA" "$MODELS"; mkdir -p "$MODELS"
