@@ -42,10 +42,12 @@ def main(argv=None):
                    choices=["full", "dots"],
                    help="remat granularity (with --remat) — lets the "
                         "trace match a remat bench default exactly")
-    p.add_argument("--fused_loss", "--fused-loss", action="store_true",
-                   help="trace the fused subpixel-domain loss path "
-                        "(TrainConfig.fused_loss) so the profile matches "
-                        "a fused-default bench config")
+    p.add_argument("--fused_loss", "--fused-loss",
+                   action=argparse.BooleanOptionalAction, default=None,
+                   help="loss path to trace; default None = the config's "
+                        "auto (fused where available), matching what "
+                        "default training runs — pass --no-fused-loss to "
+                        "trace the reference-exact full-resolution loss")
     p.add_argument("--fp32", action="store_true",
                    help="disable bf16 mixed precision")
     p.add_argument("--trace-dir", default=None,
@@ -72,7 +74,8 @@ def main(argv=None):
     rng = jax.random.PRNGKey(0)
     print(f"backend={jax.default_backend()} batch={args.batch} hw={h}x{w} "
           f"iters={args.iters} bf16={not args.fp32} remat={args.remat} "
-          f"corr_impl={model_cfg.corr_impl} fused_loss={args.fused_loss}")
+          f"corr_impl={model_cfg.corr_impl} fused_loss="
+          f"{'auto' if args.fused_loss is None else args.fused_loss}")
     t0 = time.perf_counter()
     state = create_train_state(model_cfg, train_cfg, rng, image_hw=(h, w))
     step = jax.jit(make_train_step(model_cfg, train_cfg),

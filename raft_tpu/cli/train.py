@@ -41,7 +41,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="exponential weighting")
     p.add_argument("--add_noise", action="store_true")
     p.add_argument("--alternate_corr", action="store_true")
-    p.add_argument("--fused_loss", action="store_true",
+    p.add_argument("--fused_loss", action=argparse.BooleanOptionalAction,
+                   default=None,
                    help="sequence loss in the upsampler's subpixel domain "
                         "(basic model): identical values, no full-res "
                         "prediction-stack materialization")
@@ -74,7 +75,9 @@ def configs_from_args(args) -> tuple[RAFTConfig, TrainConfig]:
         epsilon=args.epsilon, clip=args.clip, add_noise=args.add_noise,
         seed=args.seed, data_root=args.data_root,
         checkpoint_dir=args.checkpoint_dir, log_dir=args.log_dir,
-        num_workers=args.num_workers, fused_loss=args.fused_loss)
+        num_workers=args.num_workers)
+    if args.fused_loss is not None:  # tri-state: None = config auto (fused where available)
+        overrides["fused_loss"] = args.fused_loss
     for k in ("lr", "num_steps", "batch_size", "wdecay", "gamma",
               "val_freq"):
         v = getattr(args, k)

@@ -216,8 +216,17 @@ class TrainConfig:
     # compute the sequence loss in the convex upsampler's subpixel domain
     # (basic model): identical values, but the (T,B,8H,8W,2) prediction
     # stack and its cotangent never materialize — see
-    # training/loss.sequence_loss_subpixel
-    fused_loss: bool = False
+    # training/loss.sequence_loss_subpixel. Tri-state: None (default) =
+    # AUTO — fused wherever it exists (basic), standard loss for the
+    # small model (which has no fused path), silently. True = explicit
+    # request (warns if the model can't honor it); False = force the
+    # reference-exact full-resolution loss (pinned by
+    # tools/train_dynamics_parity.py for bit-level torch matching).
+    # Auto is ON by measurement (2026-08-01, v5e-1, chairs-b8 softsel
+    # bf16): fused 31-32 pairs/s vs unfused 20.2 after the shift-mulacc
+    # upsampler rework (27.0 before it — the rework sped the
+    # fused/serving paths and cost the unfused stack path).
+    fused_loss: Optional[bool] = None
 
 
 # Stage presets mirroring train_standard.sh:3-6 (2-GPU fp32 recipe).

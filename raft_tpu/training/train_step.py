@@ -85,9 +85,11 @@ def make_train_step(model_cfg: RAFTConfig, train_cfg: TrainConfig):
     # fused loss: predictions stay in the upsampler's subpixel domain and
     # the loss meets them there — the (T,B,8H,8W,2) stack (~560 MB fp32 at
     # chairs-b8) and its cotangent never materialize. Identical values
-    # (pinned in tests/test_loss_optim.py); basic model only.
-    fused = train_cfg.fused_loss and not model_cfg.small
-    if train_cfg.fused_loss and model_cfg.small:
+    # (pinned in tests/test_loss_optim.py); basic model only. Tri-state
+    # config (None = auto): the small model silently takes the standard
+    # loss under auto, and warns only on an EXPLICIT True it can't honor.
+    fused = (train_cfg.fused_loss is not False) and not model_cfg.small
+    if train_cfg.fused_loss is True and model_cfg.small:
         warnings.warn(
             "fused_loss requested with the small model, which has no "
             "fused path (its upsampling is a plain 8x interpolate, not "

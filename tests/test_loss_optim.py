@@ -202,3 +202,20 @@ def test_fused_loss_with_small_model_warns_and_falls_back():
                         stage_config("chairs", batch_size=1,
                                      fused_loss=True))
     assert not any("fused_loss" in str(w.message) for w in caught)
+
+
+def test_fused_loss_auto_default_is_silent_for_small():
+    """The tri-state default (None = auto) must NOT warn for the small
+    model — the standard-loss fallback is the expected behavior there,
+    not an ineffective user request (which is what the warning above
+    guards)."""
+    import warnings as _warnings
+
+    from raft_tpu.config import RAFTConfig, stage_config
+    from raft_tpu.training.train_step import make_train_step
+
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        make_train_step(RAFTConfig(small=True),
+                        stage_config("chairs", batch_size=1))
+    assert not [w for w in caught if "fused_loss" in str(w.message)]

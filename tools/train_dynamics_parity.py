@@ -91,7 +91,11 @@ def jax_run(sd0, batches, hw, steps, iters, lr, wdecay, eps):
     model_cfg = RAFTConfig(small=False, mixed_precision=False)
     train_cfg = TrainConfig(stage="chairs", num_steps=steps, batch_size=
                             batches[0][0].shape[0], iters=iters, lr=lr,
-                            wdecay=wdecay, epsilon=eps, add_noise=False)
+                            wdecay=wdecay, epsilon=eps, add_noise=False,
+                            # bit-level torch matching wants the
+                            # reference-exact full-resolution loss, not
+                            # the (value-equivalent) fused subpixel form
+                            fused_loss=False)
     rng = jax.random.PRNGKey(0)
     model = RAFT(model_cfg)
     img = jnp.zeros((1, *hw, 3))
