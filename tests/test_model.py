@@ -193,3 +193,58 @@ class TestScanUnroll:
             RAFTConfig(scan_unroll=0)
         with pytest.raises(ValueError):
             RAFTConfig(scan_unroll=1.5)
+
+
+class TestFusedConvPair:
+    """fused_conv_pair = two same-geometry convs as one double-width conv
+    (models/layers.py): per-channel dot products identical, param tree
+    identical to the separate convs."""
+
+    def test_matches_separate_convs_and_param_tree(self):
+        import flax.linen as nn
+
+        from raft_tpu.models.layers import TorchConv, fused_conv_pair
+
+        class Sep(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                a = TorchConv(8, (3, 3), (1, 1), (1, 1), name="ca")(x)
+                b = TorchConv(4, (3, 3), (1, 1), (1, 1), name="cb")(x)
+                return a, b
+
+        class Fused(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return fused_conv_pair(
+                    TorchConv(8, (3, 3), (1, 1), (1, 1), name="ca"),
+                    TorchConv(4, (3, 3), (1, 1), (1, 1), name="cb"), x)
+
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(2, 6, 7, 5).astype(np.float32))
+        vs = Sep().init(jax.random.PRNGKey(1), x)
+        vf = Fused().init(jax.random.PRNGKey(1), x)
+        # identical param trees (same names, shapes, and init draws)
+        assert (jax.tree_util.tree_structure(vs)
+                == jax.tree_util.tree_structure(vf))
+        for a, b in zip(jax.tree.leaves(vs), jax.tree.leaves(vf)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        sa, sb = Sep().apply(vs, x)
+        fa, fb = Fused().apply(vs, x)
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(fa))
+        np.testing.assert_array_equal(np.asarray(sb), np.asarray(fb))
+
+    def test_mismatched_geometry_asserts(self):
+        import flax.linen as nn
+
+        from raft_tpu.models.layers import TorchConv, fused_conv_pair
+
+        class Bad(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return fused_conv_pair(
+                    TorchConv(8, (3, 3), (1, 1), (1, 1), name="ca"),
+                    TorchConv(4, (1, 5), (1, 1), (0, 2), name="cb"), x)
+
+        x = jnp.zeros((1, 6, 7, 5), jnp.float32)
+        with pytest.raises(AssertionError, match="fusable"):
+            Bad().init(jax.random.PRNGKey(0), x)
