@@ -256,3 +256,20 @@ def test_train_cli_exposes_step_config_knobs():
     m2, _ = configs_from_args(build_parser().parse_args(["--stage", "chairs"]))
     assert (m2.corr_dtype, m2.corr_impl, m2.scan_unroll) == (
         "float32", "onehot", 1)
+
+
+def test_train_cli_fused_loss_tristate():
+    """--fused_loss is tri-state: absent -> the config's auto default
+    (None: fused where available), and both explicit directions thread
+    through to TrainConfig."""
+    from raft_tpu.cli.train import build_parser, configs_from_args
+
+    base = ["--stage", "chairs"]
+    _, t_auto = configs_from_args(build_parser().parse_args(base))
+    assert t_auto.fused_loss is None
+    _, t_on = configs_from_args(
+        build_parser().parse_args(base + ["--fused_loss"]))
+    assert t_on.fused_loss is True
+    _, t_off = configs_from_args(
+        build_parser().parse_args(base + ["--no-fused_loss"]))
+    assert t_off.fused_loss is False
