@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import sys
+import warnings
 
 import numpy as np
 
@@ -177,7 +178,6 @@ def main(argv=None):
     run_order = list(args.impls)
     if (args.grad and args.corr_dtype == "bfloat16" and "gather" in run_order
             and len(run_order) > 1):
-        import warnings
         warnings.warn(
             "gather+grad+bfloat16 is a known TPU-worker-crashing cell "
             "(CRASH_BISECT_r05.log); reordering it last so the other "
