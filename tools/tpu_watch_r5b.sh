@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5 late-window watchdog: poll the axon tunnel every ~6 min; on
+# each window run the marker-guarded late-window runbook
+# (tools/onchip_round5b.sh: bare HEAD bench, 3k-step sustained train,
+# --resume restart). Appends the availability trace to OUTAGE_r05.log.
+# Exits when all round-5b terminal markers exist.
+set -u
+cd /root/repo
+LOG=/root/repo/OUTAGE_r05.log
+MARK=${RAFT_R5B_MARK:-/root/.cache/raft_tpu/r5b_markers}
+while true; do
+    if [ -e "$MARK/bare_final_head" ] && [ -e "$MARK/sustained_train" ] \
+            && [ -e "$MARK/resume_check" ] && [ -e "$MARK/recorded" ]; then
+        echo "$(date -u +%H:%M:%S) r5b runbook fully done" >> "$LOG"
+        exit 0
+    fi
+    if timeout -k 10 180 python -c \
+        "import jax; assert jax.devices()[0].platform != 'cpu'" \
+        >/dev/null 2>&1; then
+        echo "$(date -u +%H:%M:%S) chip up — running round-5b runbook" \
+            >> "$LOG"
+        bash tools/onchip_round5b.sh /tmp/onchip_round5b.out
+        echo "$(date -u +%H:%M:%S) r5b runbook pass ended" >> "$LOG"
+    else
+        echo "$(date -u +%H:%M:%S) chip unavailable" >> "$LOG"
+    fi
+    sleep 180
+done
