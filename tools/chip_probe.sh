@@ -1,0 +1,15 @@
+#!/bin/bash
+# Shared TPU liveness probe: exit 0 iff the tunnel backend can actually
+# EXECUTE a jitted op, not merely enumerate devices. The tunnel has a
+# documented half-up failure mode (OUTAGE_r05.log 08:47 UTC: devices()
+# returns the chip but any compile/execute hangs forever), so callers
+# must treat enumeration-only success as down.
+#
+#   bash tools/chip_probe.sh [timeout_s]    # default 120
+set -u
+T=${1:-120}
+exec timeout -k 10 "$T" python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != 'cpu'
+jax.jit(lambda a: (a * 2).sum())(jnp.ones((8, 128))).block_until_ready()
+" >/dev/null 2>&1

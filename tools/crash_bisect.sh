@@ -27,9 +27,9 @@ MARK=${RAFT_R5_MARK:-/root/.cache/raft_tpu/r5_markers}
 mkdir -p "$MARK"
 log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
 probe() {
-    timeout -k 10 120 python -c \
-        "import jax; assert jax.devices()[0].platform != 'cpu'" \
-        >/dev/null 2>&1
+    # Shared execute probe — enumeration-only reads a half-up tunnel
+    # (devices() OK, execute hung; OUTAGE_r05.log 08:47 UTC) as up.
+    bash tools/chip_probe.sh 120
 }
 wait_chip() {
     for _ in 1 2 3 4 5 6 7 8; do

@@ -17,9 +17,10 @@ MARK=${RAFT_R5B_MARK:-/root/.cache/raft_tpu/r5b_markers}
 mkdir -p "$MARK"
 log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
 chip_up() {
-    timeout -k 10 120 python -c \
-        "import jax; assert jax.devices()[0].platform != 'cpu'" \
-        >/dev/null 2>&1
+    # Real 1-op execute probe (shared helper): a half-up tunnel —
+    # devices() enumerates, compile/execute hangs (OUTAGE_r05.log
+    # 08:47 UTC) — must read as down.
+    bash tools/chip_probe.sh 120
 }
 commit_msmt() {
     local msg=$1; shift

@@ -14,9 +14,11 @@ while true; do
         echo "$(date -u +%H:%M:%S) r5b runbook fully done" >> "$LOG"
         exit 0
     fi
-    if timeout -k 10 180 python -c \
-        "import jax; assert jax.devices()[0].platform != 'cpu'" \
-        >/dev/null 2>&1; then
+    # Real 1-op execute probe (tools/chip_probe.sh): a half-up tunnel
+    # (devices() OK, compile/execute hung — seen 08:47 UTC) must read as
+    # down, or the loop burns 900 s runbook passes against a wedged
+    # backend.
+    if bash tools/chip_probe.sh 180; then
         echo "$(date -u +%H:%M:%S) chip up — running round-5b runbook" \
             >> "$LOG"
         bash tools/onchip_round5b.sh /tmp/onchip_round5b.out
