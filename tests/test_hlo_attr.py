@@ -1,0 +1,100 @@
+"""tools/hlo_attr.py: fusion -> source-op attribution parsing.
+
+Hermetic: parses a synthetic after-optimizations HLO text (the format the
+tool consumes is XLA's dump; the fixture mirrors the lines that matter —
+fusion defs with kind/calls/metadata and fused-computation bodies).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import hlo_attr  # noqa: E402
+
+_HLO = """\
+HloModule jit_step, entry_computation_layout={()->f32[]}
+
+%fused_computation.1 (p0: bf16[8,64]) -> bf16[8,64] {
+  %p0 = bf16[8,64]{1,0} parameter(0)
+  %c = bf16[8,64]{1,0} convert(%p0), metadata={op_name="jit(step)/while/body/convert"}
+  ROOT %a = bf16[8,64]{1,0} add(%c, %c), metadata={op_name="jit(step)/while/body/add_any"}
+}
+
+%fused_computation.2 (p1: f32[4]) -> f32[4] {
+  %p1 = f32[4]{0} parameter(0)
+  ROOT %m = f32[4]{0} multiply(%p1, %p1)
+}
+
+%fused_computation.3 (p2: f32[4]) -> f32[4] {
+  %p2 = f32[4]{0} parameter(0)
+  %n = f32[4]{0} negate(%p2), metadata={op_name="jit(step)/while/body/neg"}
+  %s = f32[4]{0} subtract(%n, %p2), metadata={op_name="jit(step)/while/body/sub"}
+  ROOT %a2 = f32[4]{0} add(%s, %n), metadata={op_name="jit(step)/while/body/sub"}
+}
+
+ENTRY %main () -> f32[] {
+  %x = bf16[8,64]{1,0} parameter(0)
+  %add_convert_fusion.7 = bf16[8,64]{1,0} fusion(%x), kind=kLoop, calls=%fused_computation.1, metadata={op_name="jit(step)/transpose(jvp())/while/body"}
+  %loop_convert_convolution_add_reduce_fusion.123 = (f32[2]{0}, f32[4]{0}) fusion(%x), kind=kOutput, calls=%fused_computation.2, metadata={op_name="jit(step)/while/body/conv_general_dilated"}
+  %fusion.41 = f32[4]{0} fusion(%x), kind=kLoop, calls=%fused_computation.3
+  ROOT %fusion.33 = f32[4]{0} fusion(%x), kind=kOutput, calls=%fused_computation.2
+}
+"""
+
+
+def _write(tmp_path):
+    p = tmp_path / "module_0001.jit_step.tpu_after_optimizations.txt"
+    p.write_text(_HLO)
+    return str(tmp_path)
+
+
+def test_parse_fusions_metadata_and_kind(tmp_path):
+    d = _write(tmp_path)
+    fusions = hlo_attr.parse_fusions(os.path.join(
+        d, "module_0001.jit_step.tpu_after_optimizations.txt"))
+    assert set(fusions) == {"add_convert_fusion.7", "fusion.33", "fusion.41",
+                            "loop_convert_convolution_add_reduce_fusion.123"}
+    tup = fusions["loop_convert_convolution_add_reduce_fusion.123"]
+    assert tup["shape"] == "(f32[2]{0}, f32[4]{0})"
+    assert tup["op_name"] == "jit(step)/while/body/conv_general_dilated"
+    f7 = fusions["add_convert_fusion.7"]
+    assert f7["kind"] == "kLoop"
+    assert f7["op_name"] == "jit(step)/transpose(jvp())/while/body"
+    assert f7["calls"] == "fused_computation.1"
+    assert f7["body_lines"] == 3
+
+
+def test_body_fallback_when_root_has_no_metadata(tmp_path):
+    d = _write(tmp_path)
+    fusions = hlo_attr.parse_fusions(os.path.join(
+        d, "module_0001.jit_step.tpu_after_optimizations.txt"))
+    # fusion.33's def line carries no metadata and its body has none
+    # either -> stays unattributed (no crash)
+    assert fusions["fusion.33"]["op_name"] == "(no metadata)"
+    # fusion.41's def line has no metadata but its body does -> the
+    # most-frequent body op_name wins (sub appears twice, neg once)
+    assert fusions["fusion.41"]["op_name"] == "(body) jit(step)/while/body/sub"
+
+
+def test_missing_dump_dir_is_not_a_traceback(tmp_path, capsys):
+    assert hlo_attr.main([str(tmp_path / "no-such-dir")]) == 1
+    assert "after_optimizations" in capsys.readouterr().err
+
+
+def test_main_substring_match_and_top(tmp_path, capsys):
+    d = _write(tmp_path)
+    # a 48-char-truncated paste from trace_summary (tail cut off) must
+    # still match via substring
+    truncated = "loop_convert_convolution_add_reduce_fusion.123"[:40]
+    assert hlo_attr.main([d, "fusion.7", truncated, "--top", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "add_convert_fusion.7" in out
+    assert "jit(step)/transpose(jvp())/while/body" in out
+    assert "loop_convert_convolution_add_reduce_fusion.123" in out
+    assert "# top 2 fusions" in out
+
+
+def test_main_missing_dump_dir_errors(tmp_path, capsys):
+    assert hlo_attr.main([str(tmp_path)]) == 1
+    assert "after_optimizations" in capsys.readouterr().err

@@ -1,0 +1,142 @@
+"""Map XLA fusion names from a trace/HLO dump back to source ops.
+
+`cli/trace_summary.py` names the top ops (`fusion.1989`,
+`convolution_add_fusion.49`, ...) but an XProf trace carries no source
+attribution, which left the round-5 scan-body band (six conv fusions at
+20-80 GB/s effective, ~44 ms/step — PROFILE.md tail) unattributable.
+This closes the loop: run the same step with
+``XLA_FLAGS="--xla_dump_to=DIR --xla_dump_hlo_as_text"`` alongside the
+trace capture, then::
+
+    python tools/hlo_attr.py DIR fusion.1989 convolution_add_fusion.49
+    python tools/hlo_attr.py DIR --top 25       # largest fusions by body size
+
+For each fusion the tool prints its root op kind, operand/result shapes
+and the ``metadata.op_name`` JAX path (e.g.
+``jit(train_step)/transpose(jvp(...))/while/body/...``), which names the
+model-source op the fusion came from.  Reference analog: the profiling
+story nvprof/nsys gives the CUDA reference for free via kernel names
+(alt_cuda_corr/correlation_kernel.cu:19 names its own kernels); XLA
+fusions need this mapping step instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<shape>\([^)]*\)|\S+)\s+fusion\(")
+_META_RE = re.compile(r'op_name="(?P<op>[^"]*)"')
+_CALLS_RE = re.compile(r"calls=%(?P<comp>[\w.\-]+)")
+_KIND_RE = re.compile(r"kind=(?P<kind>k\w+)")
+
+
+def _pick_module(dump_dir: str) -> Optional[str]:
+    """Largest after-optimizations HLO text in the dump (the main jit)."""
+    cands: List[Tuple[int, str]] = []
+    if not os.path.isdir(dump_dir):
+        return None
+    for fn in os.listdir(dump_dir):
+        if fn.endswith("after_optimizations.txt"):
+            p = os.path.join(dump_dir, fn)
+            cands.append((os.path.getsize(p), p))
+    return max(cands)[1] if cands else None
+
+
+def parse_fusions(path: str) -> Dict[str, dict]:
+    """name -> {shape, kind, op_name, calls, body_lines} for every fusion."""
+    fusions: Dict[str, dict] = {}
+    comp_sizes: Dict[str, int] = {}
+    comp_ops: Dict[str, List[str]] = {}
+    cur_comp = None
+    with open(path) as f:
+        for line in f:
+            m = re.match(r"^(?:ENTRY\s+)?%(?P<comp>[\w.\-]+)\s+\(", line)
+            if m:
+                # ENTRY opens the top-level computation: stop attributing
+                # lines to the previous fused computation
+                cur_comp = None if line.startswith("ENTRY") \
+                    else m.group("comp")
+                if cur_comp is not None:
+                    comp_sizes[cur_comp] = 0
+                    comp_ops[cur_comp] = []
+                continue
+            if line.strip() == "}":
+                cur_comp = None
+            elif cur_comp is not None and line.strip():
+                comp_sizes[cur_comp] += 1
+                bm = _META_RE.search(line)
+                if bm:
+                    comp_ops[cur_comp].append(bm.group("op"))
+            d = _DEF_RE.match(line)
+            if d:
+                meta = _META_RE.search(line)
+                calls = _CALLS_RE.search(line)
+                kind = _KIND_RE.search(line)
+                fusions[d.group("name")] = {
+                    "shape": d.group("shape"),
+                    "kind": kind.group("kind") if kind else "?",
+                    "op_name": meta.group("op") if meta else "(no metadata)",
+                    "calls": calls.group("comp") if calls else None,
+                }
+    for info in fusions.values():
+        info["body_lines"] = comp_sizes.get(info["calls"] or "", 0)
+        if info["op_name"] == "(no metadata)":
+            # fall back to the fused computation's own ops: report the
+            # most frequent op_name in the body
+            ops = comp_ops.get(info["calls"] or "", [])
+            if ops:
+                # max over the list: first-seen wins ties (deterministic)
+                best = max(ops, key=ops.count)
+                info["op_name"] = f"(body) {best}"
+    return fusions
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump_dir", help="--xla_dump_to directory")
+    ap.add_argument("names", nargs="*",
+                    help="fusion names from trace_summary (suffix match)")
+    ap.add_argument("--top", type=int, default=0,
+                    help="also print the N largest fusions by body size")
+    args = ap.parse_args(argv)
+
+    mod = _pick_module(args.dump_dir)
+    if mod is None:
+        print(f"no *after_optimizations*.txt under {args.dump_dir}; "
+              "run with XLA_FLAGS='--xla_dump_to=DIR "
+              "--xla_dump_hlo_as_text'", file=sys.stderr)
+        return 1
+    print(f"# module: {os.path.basename(mod)}")
+    fusions = parse_fusions(mod)
+
+    def show(name: str, info: dict) -> None:
+        print(f"{name}  {info['kind']:>8}  {info['shape']:<28} "
+              f"body={info['body_lines']:<4} {info['op_name']}")
+
+    for want in args.names:
+        # substring match: trace_summary truncates hlo_op_name to 48
+        # chars, so a pasted name may be missing its tail (.N suffix)
+        hits = {n: i for n, i in fusions.items() if want in n}
+        if not hits:
+            print(f"{want}  NOT FOUND (fusion names are per-compile; "
+                  "dump and trace must come from the same run)")
+        for n, i in sorted(hits.items()):
+            show(n, i)
+
+    if args.top:
+        print(f"# top {args.top} fusions by body size")
+        ranked = sorted(fusions.items(),
+                        key=lambda kv: -kv[1]["body_lines"])[:args.top]
+        for n, i in ranked:
+            show(n, i)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
