@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-5c runbook: attribute the remaining scan-body band.
+#
+# The post-rework trace (PROFILE.md tail) leaves ~44 ms/step in six
+# conv fusions at 20-80 GB/s effective that an XProf trace alone cannot
+# attribute. This pass captures a fresh trace AND the matching XLA
+# after-optimizations dump from the SAME process, then maps the top
+# fusion names to source ops with tools/hlo_attr.py. Marker-guarded,
+# cheap (~3-6 min), safe to fire on any window after the 5b musts.
+#
+#   trace_attr    profile_step (bench defaults) + trace_summary +
+#                 hlo_attr -> PROFILE_r05c.log committed
+set -u
+cd /root/repo
+OUT=${1:-/tmp/onchip_round5c.out}
+MARK=${RAFT_R5B_MARK:-/root/.cache/raft_tpu/r5b_markers}
+mkdir -p "$MARK"
+log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
+
+if [ ! -e "$MARK/trace_attr" ]; then
+    bash tools/chip_probe.sh 120 || exit 1
+    log "begin trace_attr (profile_step + XLA dump at bench defaults)"
+    rm -rf /tmp/trace_r5c /tmp/hlo_r5c
+    if timeout 900 env \
+            XLA_FLAGS="--xla_dump_to=/tmp/hlo_r5c --xla_dump_hlo_as_text" \
+            python -m raft_tpu.cli.profile_step --batch 8 --hw 368 496 \
+            --corr_impl softsel --corr_dtype bfloat16 --fused-loss \
+            --steps 2 --trace-dir /tmp/trace_r5c >> "$OUT" 2>&1 \
+            && timeout 300 python -m raft_tpu.cli.trace_summary \
+            /tmp/trace_r5c --top 30 > /tmp/r5c_summary.txt 2>&1; then
+        # op names are the LAST field of each top-op row; the bare token
+        # "fusion" from category columns ("loop fusion") must not leak
+        # into hlo_attr's substring match
+        NAMES=$(awk '{print $NF}' /tmp/r5c_summary.txt | grep fusion \
+            | grep -vx 'fusion' | sort -u | head -40)
+        {
+            echo "# Round-5c trace attribution ($(date -u +%F\ %H:%M) UTC)"
+            echo "# profile_step --batch 8 --hw 368 496 --corr_impl softsel"
+            echo "#   --corr_dtype bfloat16 --fused-loss (bench defaults)"
+            cat /tmp/r5c_summary.txt
+            echo
+            echo "# hlo_attr: top-trace fusion names -> source ops"
+            if [ -n "$NAMES" ]; then
+                # shellcheck disable=SC2086
+                python tools/hlo_attr.py /tmp/hlo_r5c $NAMES 2>&1
+            else
+                echo "(no fusion names found in the trace summary)"
+            fi
+            echo
+            echo "# hlo_attr --top 25 (largest fused computations)"
+            python tools/hlo_attr.py /tmp/hlo_r5c --top 25 2>&1
+        } > PROFILE_r05c.log
+        touch "$MARK/trace_attr"
+        git add PROFILE_r05c.log 2>/dev/null || true
+        git diff --cached --quiet || git commit -q \
+            -m "Round-5c: trace + HLO-dump attribution of the scan-body band" \
+            -m "No-Verification-Needed: measurement logs and records only"
+        log "done trace_attr"
+    else
+        log "FAILED trace_attr"
+    fi
+fi
+log "round5c pass complete"

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5 combined watchdog (supersedes tpu_watch_r5b.sh): poll the
+# tunnel every ~3 min with the real-execute probe; on a window run the
+# 5b musts (bare HEAD bench refresh, 3k-step sustained train, --resume
+# proof) then the 5c attribution pass. Exits when every marker exists.
+set -u
+cd /root/repo
+LOG=/root/repo/OUTAGE_r05.log
+MARK=${RAFT_R5B_MARK:-/root/.cache/raft_tpu/r5b_markers}
+while true; do
+    if [ -e "$MARK/bare_final_head" ] && [ -e "$MARK/sustained_train" ] \
+            && [ -e "$MARK/resume_check" ] && [ -e "$MARK/recorded" ] \
+            && [ -e "$MARK/trace_attr" ]; then
+        echo "$(date -u +%H:%M:%S) r5b+r5c runbooks fully done" >> "$LOG"
+        exit 0
+    fi
+    # Half-up tunnel (devices() OK, execute hangs) must read as down.
+    if bash tools/chip_probe.sh 180; then
+        echo "$(date -u +%H:%M:%S) chip up — running r5b+r5c runbooks" \
+            >> "$LOG"
+        bash tools/onchip_round5b.sh /tmp/onchip_round5b.out
+        bash tools/onchip_round5c.sh /tmp/onchip_round5c.out
+        echo "$(date -u +%H:%M:%S) runbook pass ended" >> "$LOG"
+    else
+        echo "$(date -u +%H:%M:%S) chip unavailable" >> "$LOG"
+    fi
+    sleep 180
+done
