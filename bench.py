@@ -56,7 +56,12 @@ ITERS = 12                     # train.py:232
 START = time.monotonic() - float(os.environ.get("RAFT_BENCH_ELAPSED") or 0.0)
 
 
+LAST_PROGRESS = time.monotonic()
+
+
 def log(msg):
+    global LAST_PROGRESS
+    LAST_PROGRESS = time.monotonic()
     print(f"[bench +{time.monotonic() - START:7.1f}s] {msg}", file=sys.stderr,
           flush=True)
 
@@ -139,6 +144,41 @@ def run(batch_size, remat, warmup, steps, overrides, image_hw=IMAGE_HW,
     log(f"avg step {dt * 1e3:.1f} ms over {steps} steps (value-fetch "
         f"fenced), final loss={loss:.3f}")
     return batch_size / dt
+
+
+def start_hang_watch(shape_tag, hang_s, interval=30.0, stop=None):
+    """Daemon that converts a silent mid-run wedge into a recorded 0.0.
+
+    A wedge can develop AFTER the backend probe passed (observed 15:51
+    UTC: bare bench green 15:45-15:50, the very next process's compile
+    hung forever — the tunnel's half-up mode). log() stamps
+    LAST_PROGRESS; if nothing progressed for ``hang_s`` the daemon
+    prints the failure JSON the driver expects and hard-exits, instead
+    of hanging until the driver's own timeout records nothing at all.
+    """
+    import threading
+
+    if hang_s <= 0:  # explicit disable
+        return None
+
+    def _watch():
+        while True:
+            time.sleep(interval)
+            if stop is not None and stop.is_set():
+                return
+            stale = time.monotonic() - LAST_PROGRESS
+            if stale > hang_s:
+                print(f"[bench] no progress for {stale:.0f}s — backend "
+                      "wedged (half-up tunnel); emitting failure JSON",
+                      file=sys.stderr, flush=True)
+                emit(f"raft_basic_train_{shape_tag}_backend_wedged", 0.0)
+                os._exit(2)
+                return  # unreachable in production; ends the thread when
+                # tests stub os._exit
+
+    t = threading.Thread(target=_watch, daemon=True)
+    t.start()
+    return t
 
 
 def emit(metric, value):
@@ -255,6 +295,12 @@ def _build_parser(suppress=False):
     p.add_argument("--steps", type=int, default=default(20))
     p.add_argument("--deadline-s", type=float, default=default(2400.0),
                    help="no new attempt starts after this wall-clock budget")
+    p.add_argument("--hang-s", type=float, default=default(720.0),
+                   help="emit the failure JSON and exit if no progress "
+                        "for this long (half-up tunnel: compile/execute "
+                        "hangs AFTER the probe passed); longest healthy "
+                        "gap observed is ~280 s of host-side data build; "
+                        "<=0 disables the watchdog")
     p.add_argument("--corr-impl", default=default(None),
                    choices=["gather", "onehot", "onehot_t", "softsel", "softsel_t", "pallas"],
                    help="override RAFTConfig.corr_impl")
@@ -299,6 +345,13 @@ def main():
     stage = "chairs_" if (h, w) == IMAGE_HW else ""
     shape_tag = f"{stage}{h}x{w}"
 
+    # Arm the no-progress watchdog BEFORE any backend dial: the
+    # in-process jax.devices() below can itself block ~25 min
+    # uninterruptibly on a wedged claim (the round-2 1,506 s loss), and
+    # the probe attempts' own bounded timeouts (≤570 s worst case
+    # between log stamps) stay under the default threshold.
+    start_hang_watch(shape_tag, args.hang_s)
+
     # Probe the backend in a TIME-BOUNDED subprocess first: a wedged
     # tunnel claim blocks jax.devices() in-process for ~25 min with no
     # way to interrupt it (round-2 driver log lost 1,506 s to exactly
@@ -312,10 +365,18 @@ def main():
     # force-registers the axon plugin, and a bare subprocess would dial
     # the tunnel even under JAX_PLATFORMS=cpu.
     repo = os.path.dirname(os.path.abspath(__file__))
+    # the probe must EXECUTE a jitted op, not merely enumerate: the
+    # tunnel's half-up mode (OUTAGE_r05.log 08:27, 15:51 UTC) answers
+    # jax.devices() but hangs any compile/execute forever — an
+    # enumeration-only probe reads that as a healthy window and the
+    # bench then wedges until the driver's timeout (tools/chip_probe.sh
+    # learned the same lesson)
     probe = (f"import sys; sys.path.insert(0, {repo!r}); "
              "from raft_tpu.utils.platform import respect_cpu_request; "
              "respect_cpu_request(); "
-             "import jax; d = jax.devices(); assert d; "
+             "import jax, jax.numpy as jnp; d = jax.devices(); assert d; "
+             "jax.jit(lambda a: (a * 2).sum())(jnp.ones((8, 128)))"
+             ".block_until_ready(); "
              "print(d[0].platform)")
     # Two probe attempts 90 s apart: the worker's observed crash-on-exit
     # mode (dies right after the PREVIOUS client exits, self-recovers in

@@ -298,3 +298,57 @@ class TestScanUnrollPlumbing:
         # "applied" log line
         bench, _ = modules
         assert not bench._DEFAULTS_SCHEMA["scan_unroll"](True)
+
+
+class TestHangWatch:
+    """start_hang_watch: the half-up-tunnel wedge must become a recorded
+    0.0 JSON, not a silent hang until the driver's timeout."""
+
+    def test_fires_on_staleness_and_emits_failure_json(self, modules,
+                                                       monkeypatch, capsys):
+        bench, _ = modules
+        calls = {}
+        monkeypatch.setattr(bench.os, "_exit",
+                            lambda code: calls.setdefault("exit", code))
+        # stamp progress far in the past, then let one watch tick run
+        bench.LAST_PROGRESS = bench.time.monotonic() - 999.0
+        t = bench.start_hang_watch("chairs368x496", hang_s=1.0,
+                                   interval=0.05)
+        t.join(timeout=5.0)
+        assert calls.get("exit") == 2
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        rec = json.loads(out)
+        assert rec["metric"] == \
+            "raft_basic_train_chairs368x496_backend_wedged"
+        assert rec["value"] == 0.0
+
+    def test_does_not_fire_while_progress_is_fresh(self, modules,
+                                                   monkeypatch, capsys):
+        import threading
+
+        bench, _ = modules
+        fired = {}
+        monkeypatch.setattr(bench.os, "_exit",
+                            lambda code: fired.setdefault("exit", code))
+        bench.log("progress")  # stamps LAST_PROGRESS = now
+        stop = threading.Event()
+        t = bench.start_hang_watch("chairs368x496", hang_s=60.0,
+                                   interval=0.05, stop=stop)
+        bench.time.sleep(0.3)  # several ticks, none stale
+        assert "exit" not in fired
+        # end the watcher before monkeypatch restores the real os._exit
+        stop.set()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+
+    def test_nonpositive_hang_s_disables(self, modules):
+        bench, _ = modules
+        assert bench.start_hang_watch("chairs368x496", hang_s=0.0) is None
+        assert bench.start_hang_watch("chairs368x496", hang_s=-1.0) is None
+
+    def test_probe_requires_a_real_execute(self):
+        # the probe source must jit-EXECUTE, not merely enumerate: the
+        # half-up tunnel answers devices() but hangs execute
+        src = open("/root/repo/bench.py").read()
+        probe = src.split("probe = (")[1].split("print(d[0].platform)")[0]
+        assert "jax.jit" in probe and "block_until_ready" in probe
