@@ -227,6 +227,15 @@ class TrainConfig:
     # upsampler rework (27.0 before it — the rework sped the
     # fused/serving paths and cost the unfused stack path).
     fused_loss: Optional[bool] = None
+    # no-progress watchdog (utils/watchdog.HangWatch): hard-exit code 3
+    # if the training loop makes no progress for this many seconds — the
+    # remote tunnel's half-up mode blocks compile/execute forever with
+    # nothing to catch, and a wedged run otherwise sleeps out its whole
+    # runbook timeout (measured: 25 min of a live window lost, OUTAGE_r05
+    # 15:51). 0 disables (default). Set it ABOVE the longest legitimate
+    # gap: first-step compile plus a full validation pass both count as
+    # one gap (beats happen per loop iteration and after validation).
+    hang_s: float = 0.0
 
 
 # Stage presets mirroring train_standard.sh:3-6 (2-GPU fp32 recipe).

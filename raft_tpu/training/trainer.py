@@ -102,6 +102,13 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
     logger = Logger(os.path.join(train_cfg.log_dir, train_cfg.name),
                     train_cfg.sum_freq, lr_fn=schedule)
     logger.start_at(int(state.step))
+    # half-up tunnel fence: a wedged backend blocks dispatch/fetch with
+    # nothing to catch; exit code 3 lets runbooks re-probe instead of
+    # sleeping out their timeout (see utils/watchdog and
+    # TrainConfig.hang_s)
+    from raft_tpu.utils.watchdog import HangWatch
+    hang_watch = HangWatch(train_cfg.hang_s, label="train loop")
+    hang_watch.start()
 
     with mesh:
         state = jax.device_put(state, replicated(mesh))
@@ -154,6 +161,7 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
                 # (a host-side split here cost ~730 ms/step of pipelining
                 # on the remote tunnel — BENCH_NOTES.md round 5)
                 state, metrics = step_fn(state, sharded, rng)
+                hang_watch.beat()
                 if profiling and total_steps >= prof[1]:
                     jax.block_until_ready(metrics)
                     jax.profiler.stop_trace()
@@ -184,6 +192,7 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
                         train_cfg.validation, train_cfg.data_root)
                     if results:
                         logger.write_dict(results)
+                    hang_watch.beat()  # a long validation is not a wedge
 
                 if total_steps >= train_cfg.num_steps:
                     keep_training = False
@@ -200,6 +209,7 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
         jax.device_get(ckpt_lib.variables_from_state(state)))
     print(f"saved final weights to {final_path}", flush=True)
     ckpt_lib.close_all()  # flush pending async Orbax saves
+    hang_watch.stop()  # in-process callers must not inherit the daemon
     logger.close()
     return state
 

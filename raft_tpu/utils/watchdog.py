@@ -1,0 +1,79 @@
+"""No-progress watchdog for long device-bound loops on remote backends.
+
+The tunnel backend has a documented half-up failure mode — device
+enumeration succeeds, then any compile/execute blocks forever with no
+exception to catch (OUTAGE_r05.log 08:27, 15:51 UTC; a wedged train
+burned 25 min of a live window before being killed by hand). In-process
+there is nothing to interrupt, so the only honest recovery is a daemon
+thread that watches a heartbeat and hard-exits the process with a
+distinctive code, letting the caller (runbook, driver) log the failure
+and re-probe instead of sleeping out its whole timeout budget.
+
+The reference has no analog — local CUDA either works or raises; a
+remote-tunnel TPU claim can silently wedge, which makes this a
+TPU-deployment subsystem (SURVEY.md §5 failure detection).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+#: process exit code when the watchdog fires (distinct from OOM/crash
+#: paths so runbooks can tell "wedged" from "broken")
+WEDGED_EXIT_CODE = 3
+
+
+class HangWatch:
+    """Fire ``on_fire`` (default: diagnose + ``os._exit(3)``) if
+    :meth:`beat` hasn't been called for ``hang_s`` seconds.
+
+    ``hang_s <= 0`` disables the watchdog entirely: :meth:`start`
+    returns None and :meth:`beat` is a no-op stamp. Beats are a single
+    monotonic-clock store — safe to call per training-loop iteration.
+    """
+
+    def __init__(self, hang_s: float, label: str = "loop",
+                 interval: float = 30.0,
+                 on_fire: Optional[Callable[[float], None]] = None):
+        self.hang_s = float(hang_s)
+        self.label = label
+        self.interval = interval
+        self._on_fire = on_fire
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _fire(self, stale: float) -> None:
+        if self._on_fire is not None:
+            self._on_fire(stale)
+            return
+        print(f"[watchdog] {self.label}: no progress for {stale:.0f}s — "
+              "backend wedged (half-up tunnel); exiting "
+              f"{WEDGED_EXIT_CODE} so the caller can re-probe",
+              file=sys.stderr, flush=True)
+        os._exit(WEDGED_EXIT_CODE)
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.interval):
+            stale = time.monotonic() - self._last
+            if stale > self.hang_s:
+                self._fire(stale)
+                return
+
+    def start(self) -> Optional[threading.Thread]:
+        if self.hang_s <= 0:
+            return None
+        self.beat()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+        return self._thread
