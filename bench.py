@@ -44,6 +44,10 @@ import numpy as np
 # honor JAX_PLATFORMS=cpu + persistent compile cache (multi-minute
 # remote compiles are skipped on repeat runs)
 from raft_tpu.utils.platform import setup_cli  # noqa: E402
+# one failure mode, one exit code: the wedge watchdog below must exit
+# with the SAME distinctive code as the trainer's watchdog so runbooks
+# branch once (round-5 advisor: bench exited 2, trainer 3)
+from raft_tpu.utils.watchdog import WEDGED_EXIT_CODE  # noqa: E402
 
 setup_cli()
 
@@ -172,12 +176,15 @@ def start_hang_watch(shape_tag, hang_s, interval=30.0, stop=None):
                       "wedged (half-up tunnel); emitting failure JSON",
                       file=sys.stderr, flush=True)
                 emit(f"raft_basic_train_{shape_tag}_backend_wedged", 0.0)
-                os._exit(2)
+                os._exit(WEDGED_EXIT_CODE)
                 return  # unreachable in production; ends the thread when
                 # tests stub os._exit
 
+    # process-lifetime by design: the watchdog must survive every
+    # exception path of the bench to convert a wedge into the failure
+    # JSON — there is deliberately no stop/finally here
     t = threading.Thread(target=_watch, daemon=True)
-    t.start()
+    t.start()  # graftlint: disable=R5
     return t
 
 

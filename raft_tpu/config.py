@@ -233,8 +233,11 @@ class TrainConfig:
     # nothing to catch, and a wedged run otherwise sleeps out its whole
     # runbook timeout (measured: 25 min of a live window lost, OUTAGE_r05
     # 15:51). 0 disables (default). Set it ABOVE the longest legitimate
-    # gap: first-step compile plus a full validation pass both count as
-    # one gap (beats happen per loop iteration and after validation).
+    # gap: beats happen at each sum_freq metric flush (a real D2H fetch
+    # — async dispatch alone proves nothing), after validation, and at
+    # cleanup entry, so first-step compile plus a full sum_freq window,
+    # a full validation pass, and the final async-checkpoint flush each
+    # count as one gap.
     hang_s: float = 0.0
 
 

@@ -110,107 +110,142 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
     hang_watch = HangWatch(train_cfg.hang_s, label="train loop")
     hang_watch.start()
 
-    with mesh:
-        state = jax.device_put(state, replicated(mesh))
-        total_steps = int(state.step)
-        keep_training = total_steps < train_cfg.num_steps
-        prof = train_cfg.profile_steps
-        profiling = False
-        # Metrics accumulate ON DEVICE and are fetched once per sum_freq
-        # window: fetching per-step scalars costs one D2H round trip per
-        # step, which on a remote backend caps the loop at ~1/RTT steps/s
-        # (measured 0.72 steps/s against a ~3 steps/s device, session C).
-        metric_sums = None
-        acc_steps = 0
-        acc_fn = jax.jit(
-            lambda acc, m: jax.tree_util.tree_map(jnp.add, acc, m),
-            donate_argnums=(0,))
+    # try/finally: the armed daemon must not outlive train() on the
+    # exception path (data error, OOM, KeyboardInterrupt) — an
+    # in-process caller that catches the exception would otherwise be
+    # hard-killed by os._exit(WEDGED_EXIT_CODE) once hang_s elapses
+    # with no beats (ADVICE.md round 5)
+    try:
+        with mesh:
+            state = jax.device_put(state, replicated(mesh))
+            total_steps = int(state.step)
+            keep_training = total_steps < train_cfg.num_steps
+            prof = train_cfg.profile_steps
+            profiling = False
+            # Metrics accumulate ON DEVICE and are fetched once per
+            # sum_freq window: fetching per-step scalars costs one D2H
+            # round trip per step, which on a remote backend caps the
+            # loop at ~1/RTT steps/s (measured 0.72 steps/s against a
+            # ~3 steps/s device, session C).
+            metric_sums = None
+            acc_steps = 0
+            acc_fn = jax.jit(
+                lambda acc, m: jax.tree_util.tree_map(jnp.add, acc, m),
+                donate_argnums=(0,))
 
-        def flush_metrics():
-            nonlocal metric_sums, acc_steps
-            if acc_steps:
-                sums = jax.device_get(metric_sums)
-                logger.push_sums(
-                    {k: float(v) for k, v in sums.items()
-                     if k in ("loss", "epe", "1px", "3px", "5px")},
-                    acc_steps)
-                metric_sums, acc_steps = None, 0
+            def flush_metrics():
+                nonlocal metric_sums, acc_steps
+                if acc_steps:
+                    sums = jax.device_get(metric_sums)
+                    # the fetch above is a real D2H round trip — proof
+                    # of COMPLETED device work, unlike the async
+                    # dispatch return of step_fn — so it is the honest
+                    # heartbeat: a mid-train wedge stops flushes and
+                    # the watchdog fires within hang_s
+                    hang_watch.beat()
+                    logger.push_sums(
+                        {k: float(v) for k, v in sums.items()
+                         if k in ("loss", "epe", "1px", "3px", "5px")},
+                        acc_steps)
+                    metric_sums, acc_steps = None, 0
 
-        def device_batches(host_loader, depth=2):
-            """shard_batch runs ``depth`` batches ahead of consumption:
-            jax transfers are async, so H2D of batch N+1 overlaps the
-            device compute of batch N instead of serializing with it."""
-            from collections import deque
+            def device_batches(host_loader, depth=2):
+                """shard_batch runs ``depth`` batches ahead of
+                consumption: jax transfers are async, so H2D of batch
+                N+1 overlaps the device compute of batch N instead of
+                serializing with it."""
+                from collections import deque
 
-            buf = deque()
-            for host_batch in host_loader:
-                buf.append(shard_batch(host_batch, mesh))
-                if len(buf) >= depth:
+                buf = deque()
+                for host_batch in host_loader:
+                    buf.append(shard_batch(host_batch, mesh))
+                    if len(buf) >= depth:
+                        yield buf.popleft()
+                while buf:
                     yield buf.popleft()
-            while buf:
-                yield buf.popleft()
 
-        while keep_training:
-            for sharded in device_batches(loader):
-                if (prof and not profiling
-                        and prof[0] <= total_steps < prof[1]):
-                    jax.profiler.start_trace(
-                        os.path.join(train_cfg.log_dir, train_cfg.name))
-                    profiling = True
-                # constant base key: the step fold_ins state.step itself
-                # (a host-side split here cost ~730 ms/step of pipelining
-                # on the remote tunnel — BENCH_NOTES.md round 5)
-                state, metrics = step_fn(state, sharded, rng)
-                hang_watch.beat()
-                if profiling and total_steps >= prof[1]:
-                    jax.block_until_ready(metrics)
-                    jax.profiler.stop_trace()
-                    profiling = False
-                metric_sums = (metrics if metric_sums is None
-                               else acc_fn(metric_sums, metrics))
-                acc_steps += 1
-                total_steps += 1
-                # reference cadence (train.py:97-103): record/print at
-                # steps sum_freq-1, 2*sum_freq-1, ... so metrics.jsonl
-                # stays step-aligned across code versions
-                if total_steps % train_cfg.sum_freq == train_cfg.sum_freq - 1:
-                    flush_metrics()
+            while keep_training:
+                for sharded in device_batches(loader):
+                    if (prof and not profiling
+                            and prof[0] <= total_steps < prof[1]):
+                        jax.profiler.start_trace(
+                            os.path.join(train_cfg.log_dir,
+                                         train_cfg.name))
+                        profiling = True
+                    # constant base key: the step fold_ins state.step
+                    # itself (a host-side split here cost ~730 ms/step
+                    # of pipelining on the remote tunnel —
+                    # BENCH_NOTES.md round 5)
+                    state, metrics = step_fn(state, sharded, rng)
+                    if profiling and total_steps >= prof[1]:
+                        jax.block_until_ready(metrics)
+                        jax.profiler.stop_trace()
+                        profiling = False
+                    metric_sums = (metrics if metric_sums is None
+                                   else acc_fn(metric_sums, metrics))
+                    acc_steps += 1
+                    total_steps += 1
+                    # reference cadence (train.py:97-103): record/print
+                    # at steps sum_freq-1, 2*sum_freq-1, ... so
+                    # metrics.jsonl stays step-aligned across versions
+                    if (total_steps % train_cfg.sum_freq
+                            == train_cfg.sum_freq - 1):
+                        flush_metrics()
 
-                if total_steps % train_cfg.val_freq == train_cfg.val_freq - 1:
-                    flush_metrics()  # window record precedes the val record
-                    ckpt_lib.save_train_state(stage_dir, state)
-                    # <step+1>_<name>.pth analog (train.py:185-187)
-                    weights_path = os.path.join(
-                        train_cfg.checkpoint_dir,
-                        f"{total_steps + 1}_{train_cfg.name}.msgpack")
-                    ckpt_lib.save_weights(
-                        weights_path,
-                        jax.device_get(
-                            ckpt_lib.variables_from_state(state)))
-                    results = run_validation(
-                        ckpt_lib.variables_from_state(state), model_cfg,
-                        train_cfg.validation, train_cfg.data_root)
-                    if results:
-                        logger.write_dict(results)
-                    hang_watch.beat()  # a long validation is not a wedge
+                    if (total_steps % train_cfg.val_freq
+                            == train_cfg.val_freq - 1):
+                        flush_metrics()  # window record precedes val
+                        ckpt_lib.save_train_state(stage_dir, state)
+                        # <step+1>_<name>.pth analog (train.py:185-187)
+                        weights_path = os.path.join(
+                            train_cfg.checkpoint_dir,
+                            f"{total_steps + 1}_{train_cfg.name}"
+                            ".msgpack")
+                        ckpt_lib.save_weights(
+                            weights_path,
+                            jax.device_get(
+                                ckpt_lib.variables_from_state(state)))
+                        results = run_validation(
+                            ckpt_lib.variables_from_state(state),
+                            model_cfg, train_cfg.validation,
+                            train_cfg.data_root)
+                        if results:
+                            logger.write_dict(results)
+                        hang_watch.beat()  # long validation ≠ wedge
 
-                if total_steps >= train_cfg.num_steps:
-                    keep_training = False
-                    break
-        flush_metrics()
-        if profiling:
-            jax.block_until_ready(state.params)
-            jax.profiler.stop_trace()
+                    if total_steps >= train_cfg.num_steps:
+                        keep_training = False
+                        break
+            flush_metrics()
+            if profiling:
+                jax.block_until_ready(state.params)
+                jax.profiler.stop_trace()
 
-    final_path = os.path.join(train_cfg.checkpoint_dir,
-                              f"{train_cfg.name}.msgpack")
-    ckpt_lib.save_weights(
-        final_path,
-        jax.device_get(ckpt_lib.variables_from_state(state)))
-    print(f"saved final weights to {final_path}", flush=True)
-    ckpt_lib.close_all()  # flush pending async Orbax saves
-    hang_watch.stop()  # in-process callers must not inherit the daemon
-    logger.close()
+        final_path = os.path.join(train_cfg.checkpoint_dir,
+                                  f"{train_cfg.name}.msgpack")
+        ckpt_lib.save_weights(
+            final_path,
+            jax.device_get(ckpt_lib.variables_from_state(state)))
+        print(f"saved final weights to {final_path}", flush=True)
+    finally:
+        # the flush below gets its own full hang_s window — staleness
+        # is otherwise counted from the last metric flush, and a
+        # legitimate end-of-run Orbax wait near the window's edge
+        # would be hard-killed as "wedged"
+        hang_watch.beat()
+        try:
+            # flush pending async Orbax saves on EVERY path — an
+            # exception after a val-boundary save otherwise exits with
+            # a partially-written checkpoint that a resume later loads.
+            # The watchdog stays armed through this: a wedged flush
+            # must still become exit-3, not a silent hang.
+            ckpt_lib.close_all()
+        finally:
+            # stop() is a bare Event.set and cannot raise; it runs
+            # even when close_all does — in-process callers must not
+            # inherit the daemon on ANY path
+            hang_watch.stop()
+            logger.close()
     return state
 
 

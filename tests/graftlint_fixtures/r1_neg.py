@@ -1,0 +1,26 @@
+"""R1 negative: the sanctioned periodic-flush pattern (trainer.py).
+
+Syncs exist but only OUTSIDE jit bodies and either outside the hot
+loop or under a cadence guard. Never executed — parsed only.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good_jitted(x):
+    return jnp.sum(x * 2.0)
+
+
+def good_hot_loop(step_inputs, state, batch, rng, sum_freq=100):
+    step_fn = jax.jit(lambda s, b, r: (s, {"loss": jnp.sum(b)}))
+    total = 0
+    for _ in step_inputs:
+        state, metrics = step_fn(state, batch, rng)
+        total += 1
+        if total % sum_freq == sum_freq - 1:
+            # periodic flush under a cadence guard — allowed
+            sums = jax.device_get(metrics)
+            print(sums)
+    # fetch AFTER the loop fences the whole chain — allowed
+    return float(jax.device_get(metrics["loss"]))

@@ -1,0 +1,23 @@
+"""R1 positive: host syncs in a jit body and unguarded in a hot loop.
+
+Never executed — parsed by tests/test_graftlint.py only.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_jitted(x):
+    # concretizes the tracer at trace time
+    host = np.asarray(x)
+    return jnp.sum(x) + float(host.mean())
+
+
+def bad_hot_loop(step_inputs, state, batch, rng):
+    step_fn = jax.jit(lambda s, b, r: (s, {"loss": jnp.sum(b)}))
+    for _ in step_inputs:
+        state, metrics = step_fn(state, batch, rng)
+        loss = float(metrics["loss"])        # unconditional D2H per step
+        jax.block_until_ready(state)         # ditto
+    return loss

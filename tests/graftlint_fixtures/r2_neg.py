@@ -1,0 +1,16 @@
+"""R2 negative: jnp math, dtype/constant np attributes, prints outside
+the traced body — all allowed."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def clean(x):
+    y = jnp.mean(x.astype(np.float32))   # np dtype attr is fine
+    return jnp.sqrt(y) + np.pi           # np constant is fine
+
+
+def host_side(x):
+    print("host logging is fine here", np.mean(x))
+    return clean(jnp.asarray(x))

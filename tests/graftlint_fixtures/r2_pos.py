@@ -1,0 +1,15 @@
+"""R2 positive: np.* math and print on traced values inside jit."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def leaky(x):
+    m = np.mean(x)              # host math on a tracer
+    print("loss is", m)         # fires at trace time only
+    return jnp.sum(x) - m
+
+
+def also_leaky():
+    return jax.jit(lambda x: np.sqrt(x) + 1.0)

@@ -1,0 +1,15 @@
+"""R3 negative: jit hoisted out of the loop, hashable static args."""
+import jax
+
+
+def compile_once_run_many(batches, scale):
+    fn = jax.jit(lambda x: x * scale)       # one cache entry
+    outs = []
+    for b in batches:
+        outs.append(fn(b))
+    return outs
+
+
+def hashable_static(x):
+    fn = jax.jit(lambda a, cfg: a * cfg[0], static_argnums=(1,))
+    return fn(x, (2.0, 3.0))    # tuple hashes — a valid cache key

@@ -1,0 +1,20 @@
+"""R3 positive: jit created per loop iteration + unhashable static arg."""
+import jax
+import jax.numpy as jnp
+
+
+def retrace_per_iteration(batches, scale):
+    outs = []
+    for b in batches:
+        fn = jax.jit(lambda x: x * scale)   # fresh lambda every pass
+        outs.append(fn(b))
+    return outs
+
+
+def retrace_in_comprehension(batches):
+    return [jax.jit(lambda x: x + 1.0)(b) for b in batches]
+
+
+def unhashable_static(x):
+    fn = jax.jit(lambda a, cfg: a * cfg[0], static_argnums=(1,))
+    return fn(x, [2.0, 3.0])    # list in a static position: unhashable

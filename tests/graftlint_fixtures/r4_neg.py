@@ -1,0 +1,26 @@
+"""R4 negative: donated state, and non-state first params undonated."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def make_step():
+    def train_step(state, batch):
+        return state, {"loss": jnp.sum(batch)}
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+accumulate = jax.jit(lambda opt_state, g: opt_state + g,
+                     donate_argnums=(0,))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def apply_updates(train_state, grads):
+    return train_state + grads
+
+
+# weights are REUSED across calls — donation would be a bug here, and
+# the rule must not demand it for non-state first params
+serve = jax.jit(lambda variables, image: variables["w"] * image)

@@ -1,0 +1,30 @@
+"""R5 negative: stop() in a finally; lifecycle owned by a class."""
+import threading
+
+from raft_tpu.utils.watchdog import HangWatch
+
+
+def fixed_trainer_shape(train_cfg, run_steps):
+    hang_watch = HangWatch(train_cfg.hang_s, label="train loop")
+    hang_watch.start()
+    try:
+        run_steps()
+    finally:
+        hang_watch.stop()       # exception path disarms the daemon
+    return True
+
+
+class OwnsItsThread:
+    """The HangWatch shape: arming inside a class that exposes stop()."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._stop.wait,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()

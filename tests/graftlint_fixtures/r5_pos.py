@@ -1,0 +1,24 @@
+"""R5 positive — distilled from the PRE-FIX round-5 advisor findings:
+
+- trainer.py:111/212 before this PR: hang_watch.stop() only on the
+  normal-return path, so an exception left the armed daemon alive to
+  os._exit the host process later;
+- a daemon thread armed in a plain function with no try/finally.
+"""
+import threading
+
+from raft_tpu.utils.watchdog import HangWatch
+
+
+def prefix_trainer_shape(train_cfg, run_steps):
+    hang_watch = HangWatch(train_cfg.hang_s, label="train loop")
+    hang_watch.start()
+    run_steps()                 # raises -> stop() never runs
+    hang_watch.stop()
+    return True
+
+
+def prefix_bench_shape(watch_fn):
+    t = threading.Thread(target=watch_fn, daemon=True)
+    t.start()
+    return t

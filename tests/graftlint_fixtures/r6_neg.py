@@ -1,0 +1,19 @@
+"""R6 negative: named shared constant, conventional 0/1 sys.exit."""
+import os
+import sys
+
+from raft_tpu.utils.watchdog import WEDGED_EXIT_CODE
+
+
+def fixed_bench_shape(emit):
+    emit("backend_wedged", 0.0)
+    os._exit(WEDGED_EXIT_CODE)
+
+
+def main():
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())            # propagating a computed code is fine
+    sys.exit(1)                 # conventional failure is fine

@@ -1,0 +1,14 @@
+"""R6 positive — distilled from the pre-fix bench.py:175: the wedge
+watchdog exited 2 while the trainer's watchdog exited
+WEDGED_EXIT_CODE=3, splitting one failure mode across two codes."""
+import os
+import sys
+
+
+def prefix_bench_shape(emit):
+    emit("backend_wedged", 0.0)
+    os._exit(2)
+
+
+def distinctive_sys_exit():
+    sys.exit(7)

@@ -5,14 +5,17 @@ bench.py`` must fold it in without overriding explicit flags."""
 import argparse
 import importlib.util
 import json
+import os
 import sys
 
 import pytest
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_PY = os.path.join(REPO, "bench.py")
+
 
 def load_bench(name="bench_mod"):
-    spec = importlib.util.spec_from_file_location(
-        name, "/root/repo/bench.py")
+    spec = importlib.util.spec_from_file_location(name, BENCH_PY)
     bench = importlib.util.module_from_spec(spec)
     saved = sys.argv
     sys.argv = ["bench.py"]
@@ -27,7 +30,8 @@ def load_bench(name="bench_mod"):
 def modules():
     bench = load_bench()
     spec2 = importlib.util.spec_from_file_location(
-        "pick_mod", "/root/repo/tools/pick_bench_defaults.py")
+        "pick_mod", os.path.join(REPO, "tools",
+                                 "pick_bench_defaults.py"))
     pick = importlib.util.module_from_spec(spec2)
     spec2.loader.exec_module(pick)
     return bench, pick
@@ -315,7 +319,9 @@ class TestHangWatch:
         t = bench.start_hang_watch("chairs368x496", hang_s=1.0,
                                    interval=0.05)
         t.join(timeout=5.0)
-        assert calls.get("exit") == 2
+        # the SHARED wedged code (watchdog.WEDGED_EXIT_CODE), not a
+        # bench-private integer: one failure mode, one exit code
+        assert calls.get("exit") == bench.WEDGED_EXIT_CODE == 3
         out = capsys.readouterr().out.strip().splitlines()[-1]
         rec = json.loads(out)
         assert rec["metric"] == \
@@ -349,6 +355,6 @@ class TestHangWatch:
     def test_probe_requires_a_real_execute(self):
         # the probe source must jit-EXECUTE, not merely enumerate: the
         # half-up tunnel answers devices() but hangs execute
-        src = open("/root/repo/bench.py").read()
+        src = open(BENCH_PY).read()
         probe = src.split("probe = (")[1].split("print(d[0].platform)")[0]
         assert "jax.jit" in probe and "block_until_ready" in probe

@@ -89,12 +89,14 @@ class TestShardingEquivalence:
             state = create_train_state(model_cfg, train_cfg,
                                        jax.random.PRNGKey(7),
                                        image_hw=(64, 64))
-            step = jax.jit(make_train_step(model_cfg, train_cfg))
+            # two-pass mesh sweep: a jit (and its compile) per mesh
+            # config IS the test
+            step = jax.jit(make_train_step(model_cfg, train_cfg))  # graftlint: disable=R3
             with mesh:
                 state = jax.device_put(state, replicated(mesh))
                 sharded = shard_batch(batch_np, mesh)
                 _, metrics = step(state, sharded, key)
-                losses[spatial] = float(metrics["loss"])
+                losses[spatial] = float(metrics["loss"])  # graftlint: disable=R1
         assert losses[1] == pytest.approx(losses[2], rel=1e-4)
 
     def test_shard_batch_rejects_sub_halo_spatial_extent(self, rng):
@@ -161,6 +163,7 @@ class TestSpatialMemoryScaling:
                 variables)
             ss = jax.ShapeDtypeStruct((B, H, W, 3), jnp.float32,
                                       sharding=batch_sharding(mesh))
-            compiled = jax.jit(fwd).lower(vs, ss, ss).compile()
+            # per-mesh AOT compile is the measurement under test
+            compiled = jax.jit(fwd).lower(vs, ss, ss).compile()  # graftlint: disable=R3
             temps[spatial] = compiled.memory_analysis().temp_size_in_bytes
         assert temps[4] < 0.7 * temps[1], temps

@@ -1,0 +1,22 @@
+"""graftlint — AST-based JAX/TPU invariant checker for this repo.
+
+Six rules, all pure-stdlib ``ast`` (linting files that import jax must
+not itself import jax):
+
+- R1 host-sync-in-hot-path   — D2H syncs in jit bodies / step loops
+- R2 tracer-leak             — np.* math or print on traced values
+- R3 retrace-hazard          — jit-in-loop, unhashable static args
+- R4 donation-discipline     — state-threading jits w/o donate_argnums
+- R5 resource-lifecycle      — start()/daemon threads w/o try/finally
+- R6 exit-code-discipline    — raw integer exit codes
+
+Run ``python -m tools.graftlint --help`` from the repo root; the tier-1
+gate is ``tests/test_graftlint.py``.
+"""
+
+from .core import (apply_baseline, lint_file, lint_paths, load_baseline,
+                   main, write_baseline)
+from .finding import Finding
+
+__all__ = ["Finding", "apply_baseline", "lint_file", "lint_paths",
+           "load_baseline", "main", "write_baseline"]

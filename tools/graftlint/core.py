@@ -1,0 +1,325 @@
+"""graftlint driver: walk files, run rules, apply pragmas + baseline.
+
+Usage (from the repo root)::
+
+    python -m tools.graftlint raft_tpu bench.py tools tests \
+        --baseline tools/graftlint/baseline.json
+
+Exit codes: 0 clean (modulo baseline), 1 new findings, 2 usage/parse
+error. ``--json`` prints a machine-readable findings list instead of
+the human one; ``--write-baseline`` regenerates the grandfather file
+from the current findings (the burn-down workflow: fix a finding, then
+regenerate — the baseline only ever shrinks).
+
+Suppression: a ``# graftlint: disable=R1,R5`` comment on the line a
+finding anchors to (the statement's FIRST line for multi-line
+statements) suppresses those rules there; ``disable=all`` suppresses
+every rule on that line. Directories named in ``_EXCLUDED_DIRS``
+(intentionally-violating lint fixtures, caches) are skipped when
+walking, but a file passed explicitly on the command line is always
+linted — that is how the fixture tests exercise the rules.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .finding import Finding
+from .jitctx import Analysis
+
+#: directory basenames never entered when walking a directory argument
+_EXCLUDED_DIRS = {"__pycache__", ".git", "graftlint_fixtures",
+                  "node_modules", ".venv"}
+
+# rule list only — a trailing bare-word justification ("disable=R5
+# process-lifetime by design") must not be swallowed into the rule id
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable="
+    r"(all|[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    """Expand dir args to ``**/*.py`` (minus excluded dirs); keep
+    explicit file args verbatim (even non-.py: caller's choice)."""
+    out: List[str] = []
+    seen = set()
+
+    def add(path: str) -> None:
+        key = os.path.normpath(path)
+        if key not in seen:   # a file named explicitly AND reached by a
+            seen.add(key)     # dir walk must lint once, not twice
+            out.append(path)
+
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in _EXCLUDED_DIRS)
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        add(os.path.join(root, f))
+        else:
+            add(p)
+    return out
+
+
+def parse_pragmas(source: str) -> Dict[int, Optional[set]]:
+    """line number -> set of disabled rule ids (None = all rules).
+
+    Tokenized, not regexed over raw lines: the pragma must live in an
+    actual COMMENT token — a string literal that merely CONTAINS
+    "graftlint: disable=..." must not suppress findings on its line."""
+    import io
+    import tokenize
+
+    pragmas: Dict[int, Optional[set]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return pragmas   # unparsable files already yield E1 findings
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _PRAGMA_RE.search(tok.string)
+        if not m:
+            continue
+        spec = m.group(1).strip()
+        line = tok.start[0]
+        if spec.lower() == "all":
+            pragmas[line] = None
+        else:
+            pragmas[line] = {r.strip().upper() for r in spec.split(",")
+                             if r.strip()}
+    return pragmas
+
+
+def lint_file(path: str, rules=None) -> List[Finding]:
+    """All findings for one file, pragma-filtered, sorted by position."""
+    from .rules import ALL_RULES
+    rules = ALL_RULES if rules is None else rules
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except OSError as exc:
+        return [Finding(path, 0, 0, "E0", "unreadable", str(exc))]
+    try:
+        analysis = Analysis(ast.parse(source, filename=path), source,
+                            path)
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 0, exc.offset or 0, "E1",
+                        "syntax-error", exc.msg or "syntax error")]
+    pragmas = parse_pragmas(source)
+    findings: List[Finding] = []
+    for mod in rules:
+        findings.extend(mod.check(analysis))
+    kept = []
+    for f in findings:
+        disabled = pragmas.get(f.line)
+        if f.line in pragmas and (disabled is None or f.rule in disabled):
+            continue
+        kept.append(f)
+    return sorted(kept, key=lambda f: (f.line, f.col, f.rule))
+
+
+def lint_paths(paths: Sequence[str], rules=None) -> List[Finding]:
+    out: List[Finding] = []
+    for path in collect_files(paths):
+        out.extend(lint_file(path, rules=rules))
+    return out
+
+
+# -- baseline -------------------------------------------------------------
+
+# keyed on (mtime, size) so library users that lint across edits (a
+# pytest process, an editor integration) never key a baseline entry
+# off stale content
+_LINES_CACHE: Dict[str, Tuple[Tuple[float, int], List[str]]] = {}
+
+
+def _code_line(finding: Finding) -> str:
+    try:
+        st = os.stat(finding.path)
+        stamp = (st.st_mtime, st.st_size)
+    except OSError:
+        return ""
+    cached = _LINES_CACHE.get(finding.path)
+    if cached is None or cached[0] != stamp:
+        try:
+            with open(finding.path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            lines = []
+        _LINES_CACHE[finding.path] = (stamp, lines)
+    else:
+        lines = cached[1]
+    if 1 <= finding.line <= len(lines):
+        return lines[finding.line - 1].strip()
+    return ""
+
+
+def finding_key(finding: Finding) -> Tuple[str, str, str]:
+    return finding.key(_code_line(finding))
+
+
+def load_baseline(path: str) -> Counter:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return Counter(
+        (e["path"].replace("\\", "/"), e["rule"], e["code"])
+        for e in data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    entries = [{"path": k[0], "rule": k[1], "code": k[2]}
+               for k in sorted(finding_key(f) for f in findings)]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({
+            "comment": "graftlint grandfathered findings — burn down, "
+                       "never grow; regenerate with --write-baseline "
+                       "after fixing one",
+            "findings": entries,
+        }, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: List[Finding], baseline: Counter,
+                   linted_paths: Optional[Iterable[str]] = None,
+                   ) -> Tuple[List[Finding], List[Tuple[str, str, str]]]:
+    """Returns (new findings, stale baseline keys).
+
+    Stale entries are NOT a free pass: an unconsumed entry would
+    silently grandfather the next reintroduction of that exact line,
+    so the CLI fails on them and demands a regenerate (the baseline
+    must only ever shrink, and shrink EXPLICITLY). An entry whose file
+    was not in ``linted_paths`` at all (a partial run) is merely
+    unchecked, not stale; ``linted_paths=None`` treats every
+    unconsumed entry as stale."""
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        k = finding_key(f)
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+        else:
+            new.append(f)
+    if linted_paths is not None:
+        linted = {os.path.normpath(p).replace("\\", "/")
+                  for p in linted_paths}
+        checked = (lambda k: os.path.normpath(k[0]).replace("\\", "/")
+                   in linted)
+    else:
+        checked = (lambda k: True)
+    stale = sorted(k for k, n in remaining.items() if checked(k)
+                   for _ in range(n))
+    return new, stale
+
+
+# -- CLI ------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based JAX/TPU invariant checker (rules R1-R6; "
+                    "see tools/graftlint/rules/).")
+    p.add_argument("paths", nargs="+",
+                   help="files and/or directories to lint")
+    p.add_argument("--baseline", metavar="JSON",
+                   help="grandfather file: matching findings don't fail "
+                        "the run (burn-down workflow)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (list of findings)")
+    p.add_argument("--write-baseline", metavar="JSON",
+                   help="write current findings as the new baseline "
+                        "and exit 0")
+    p.add_argument("--rules", metavar="R1,R2,...",
+                   help="run only these rule ids")
+    args = p.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        from .rules import ALL_RULES
+        want = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [m for m in ALL_RULES if m.RULE in want]
+        unknown = want - {m.RULE for m in rules}
+        if unknown:
+            print(f"graftlint: unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    if args.write_baseline and args.rules:
+        # a rule-filtered regenerate would silently drop every other
+        # rule's grandfathered entries and fail the next full gate run
+        print("graftlint: refusing --write-baseline with --rules — "
+              "regenerate from a full-rule run over the gate's paths",
+              file=sys.stderr)
+        return 2
+
+    findings = lint_paths(args.paths, rules=rules)
+    hard_errors = [f for f in findings if f.rule.startswith("E")]
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline,
+                       [f for f in findings
+                        if not f.rule.startswith("E")])
+        print(f"graftlint: wrote {len(findings) - len(hard_errors)} "
+              f"finding(s) to {args.write_baseline}; pass the SAME "
+              "paths as the tier-1 gate (raft_tpu bench.py tools "
+              "tests) or the gate will fail on entries this run "
+              "never saw", file=sys.stderr)
+        return 0
+
+    stale: List[Tuple[str, str, str]] = []
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"graftlint: unreadable baseline "
+                  f"{args.baseline}: {exc}", file=sys.stderr)
+            return 2
+        if rules is not None:
+            # entries for rules outside the active filter can neither
+            # be consumed nor meaningfully checked — a --rules R5 run
+            # must not call the untouched R1 entries stale
+            active = {m.RULE for m in rules}
+            baseline = Counter({k: v for k, v in baseline.items()
+                                if k[1] in active})
+        findings, stale = apply_baseline(
+            findings, baseline, linted_paths=collect_files(args.paths))
+
+    if args.as_json:
+        # stale entries ride in the same list (rule B0) so a machine
+        # consumer sees WHY the run failed, not `[]` with rc=1
+        print(json.dumps([{
+            "path": f.path, "line": f.line, "col": f.col,
+            "rule": f.rule, "name": f.name, "message": f.message,
+        } for f in findings] + [{
+            "path": k[0], "line": 0, "col": 0, "rule": "B0",
+            "name": "stale-baseline",
+            "message": f"stale baseline entry for {k[1]}: {k[2]!r} — "
+                       "regenerate with --write-baseline",
+        } for k in stale], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"graftlint: {len(findings)} new finding(s)",
+                  file=sys.stderr)
+    if stale:
+        # a lingering entry would grandfather the NEXT reintroduction
+        # of that exact line — fail until the baseline is regenerated
+        for k in stale:
+            print(f"graftlint: stale baseline entry {k[0]} [{k[1]}] "
+                  f"{k[2]!r}", file=sys.stderr)
+        print(f"graftlint: {len(stale)} stale baseline entr(y/ies) — "
+              "the finding was fixed (good!) but the entry must go: "
+              "regenerate with --write-baseline so it cannot "
+              "grandfather a future reintroduction", file=sys.stderr)
+    return 1 if (findings or stale) else 0

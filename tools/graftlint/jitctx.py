@@ -1,0 +1,263 @@
+"""Shared AST analysis for graftlint rules: jit contexts and hot loops.
+
+Every rule needs the same three questions answered about a node:
+
+1. is it inside a function body that XLA will trace (``@jax.jit``,
+   ``jax.jit(fn)``, ``jax.jit(lambda ...)``, ``pjit``,
+   ``partial(jax.jit, ...)``)?
+2. is it inside a loop that drives a jit-compiled step function (the
+   trainer/bench hot loop, where one stray host sync serializes the
+   whole pipeline)?
+3. what name does a call target resolve to, dotted ("jax.device_get",
+   "hang_watch.stop")?
+
+This module computes all of that once per file into an :class:`Analysis`
+object the rule modules share. Pure stdlib ``ast`` — graftlint must lint
+files that import jax without importing jax itself.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+#: callables whose invocation means "trace this function with XLA"
+JIT_NAMES = {
+    "jax.jit", "jit", "pjit", "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` -> "a.b.c"; None for anything not a plain name chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jit_callable(node: ast.AST) -> bool:
+    """True if ``node`` itself names a jit transform (decorator form)."""
+    name = dotted(node)
+    if name in JIT_NAMES:
+        return True
+    # partial(jax.jit, static_argnums=...) used as a decorator factory
+    if isinstance(node, ast.Call) and dotted(node.func) in PARTIAL_NAMES:
+        return bool(node.args) and dotted(node.args[0]) in JIT_NAMES
+    return False
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    """True for a ``Call`` node that invokes a jit transform on a fn."""
+    if not isinstance(node, ast.Call):
+        return False
+    if dotted(node.func) in JIT_NAMES:
+        return True
+    # partial(jax.jit, ...)(fn) — the outer call's func is the partial
+    return is_jit_callable(node.func) and not (
+        dotted(node.func) in JIT_NAMES)
+
+
+def jit_call_kwargs(call: ast.Call) -> Dict[str, ast.expr]:
+    """Keyword args of a jit call, folding in a partial's keywords."""
+    kws = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    if isinstance(call.func, ast.Call):  # partial(jax.jit, kw=..)(fn)
+        for kw in call.func.keywords:
+            if kw.arg:
+                kws.setdefault(kw.arg, kw.value)
+    return kws
+
+
+class Analysis:
+    """One-pass per-file analysis shared by all rules."""
+
+    def __init__(self, tree: ast.Module, source: str, path: str):
+        self.tree = tree
+        self.source = source
+        self.path = path
+        self.lines = source.splitlines()
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+        #: function/lambda nodes whose body is traced by jit
+        self.jitted_bodies: Set[ast.AST] = set()
+        #: jit Call nodes (``jax.jit(...)`` invocations, not decorators)
+        self.jit_calls: List[ast.Call] = []
+        #: scope node -> {name: jit Call} for ``name = jax.jit(...)``
+        self.jit_bound: Dict[ast.AST, Dict[str, ast.Call]] = {}
+        #: loops whose body invokes a jit-bound callable
+        self.hot_loops: Set[ast.AST] = set()
+
+        self._collect_defs()
+        self._collect_jit()
+        self._collect_hot_loops()
+
+    # -- scopes -----------------------------------------------------------
+
+    def scope_of(self, node: ast.AST) -> ast.AST:
+        """Nearest enclosing function/lambda, else the module."""
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, _SCOPES):
+            cur = self.parents.get(cur)
+        return cur if cur is not None else self.tree
+
+    def scope_chain(self, node: ast.AST) -> List[ast.AST]:
+        """[innermost function, ..., module] enclosing ``node``."""
+        chain = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = self.parents.get(cur)
+            if cur is None:
+                break
+            if isinstance(cur, _SCOPES) or isinstance(cur, ast.Module):
+                chain.append(cur)
+        if not chain or not isinstance(chain[-1], ast.Module):
+            chain.append(self.tree)
+        return chain
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_loop_same_scope(self, node: ast.AST) -> Optional[ast.AST]:
+        """Nearest For/While around ``node`` not crossing a function."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, _LOOPS):
+                return cur
+            if isinstance(cur, _SCOPES):
+                return None
+            cur = self.parents.get(cur)
+        return None
+
+    def under_if_within(self, node: ast.AST, stop: ast.AST) -> bool:
+        """Is ``node`` guarded by an ``if`` somewhere below ``stop``?"""
+        cur = self.parents.get(node)
+        while cur is not None and cur is not stop:
+            if isinstance(cur, ast.If):
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+    def in_finally(self, node: ast.AST) -> bool:
+        """Is ``node`` inside some ``finally:`` suite?"""
+        cur, child = self.parents.get(node), node
+        while cur is not None:
+            if isinstance(cur, ast.Try):
+                probe: Optional[ast.AST] = child
+                while probe is not None and probe is not cur:
+                    if probe in cur.finalbody:
+                        return True
+                    probe = self.parents.get(probe)
+            child, cur = cur, self.parents.get(cur)
+        return False
+
+    # -- jit discovery ----------------------------------------------------
+
+    def _collect_defs(self) -> None:
+        # name -> FunctionDef, indexed per scope, for jit(Name) resolution
+        self._defs: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = self.scope_of(node)
+                self._defs.setdefault(scope, {})[node.name] = node
+
+    def resolve_def(self, name_node: ast.AST,
+                    at: ast.AST) -> Optional[ast.AST]:
+        """Resolve a ``Name`` to a FunctionDef visible from ``at``."""
+        if not isinstance(name_node, ast.Name):
+            return None
+        for scope in self.scope_chain(at):
+            hit = self._defs.get(scope, {}).get(name_node.id)
+            if hit is not None:
+                return hit
+        return None
+
+    def _collect_jit(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(is_jit_callable(d) for d in node.decorator_list):
+                    self.jitted_bodies.add(node)
+            if is_jit_call(node):
+                self.jit_calls.append(node)
+                if node.args:
+                    fn = node.args[0]
+                    if isinstance(fn, ast.Lambda):
+                        self.jitted_bodies.add(fn)
+                    else:
+                        target = self.resolve_def(fn, node)
+                        if target is not None:
+                            self.jitted_bodies.add(target)
+        # names bound to jit results: step_fn = jax.jit(...), incl.
+        # dotted targets (self._fn = jax.jit(serve))
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and is_jit_call(node.value):
+                for tgt in node.targets:
+                    name = tgt.id if isinstance(tgt, ast.Name) \
+                        else dotted(tgt)
+                    if name:
+                        scope = self.scope_of(node)
+                        self.jit_bound.setdefault(scope, {})[name] = \
+                            node.value
+
+    def jitted_fn_of(self, node: ast.AST) -> Optional[ast.AST]:
+        """Innermost enclosing function whose body jit traces, if any."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = self.parents.get(cur)
+            if cur in self.jitted_bodies:
+                return cur
+        return None
+
+    def in_jitted_body(self, node: ast.AST) -> bool:
+        return self.jitted_fn_of(node) is not None
+
+    # -- hot loops --------------------------------------------------------
+
+    def _visible_jit_names(self, node: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        for scope in self.scope_chain(node):
+            names.update(self.jit_bound.get(scope, {}))
+        return names
+
+    def _collect_hot_loops(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, _LOOPS):
+                continue
+            jit_names = self._visible_jit_names(node)
+            if not jit_names:
+                continue
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and dotted(sub.func) in jit_names):
+                    self.hot_loops.add(node)
+                    break
+
+    def enclosing_hot_loop(self, node: ast.AST) -> Optional[ast.AST]:
+        """Nearest hot loop around ``node`` within the same function."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if cur in self.hot_loops:
+                return cur
+            if isinstance(cur, _SCOPES):
+                return None
+            cur = self.parents.get(cur)
+        return None
+
+
+def analyze(source: str, path: str) -> Analysis:
+    return Analysis(ast.parse(source, filename=path), source, path)

@@ -1,0 +1,11 @@
+"""Rule registry: each module exposes RULE, NAME, and check(analysis)."""
+
+from __future__ import annotations
+
+from . import donation, exit_code, host_sync, lifecycle, retrace, \
+    tracer_leak
+
+ALL_RULES = (host_sync, tracer_leak, retrace, donation, lifecycle,
+             exit_code)
+
+RULE_IDS = {mod.RULE for mod in ALL_RULES}

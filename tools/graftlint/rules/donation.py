@@ -1,0 +1,90 @@
+"""R4 donation-discipline.
+
+A train/optimizer state threaded through a jitted step WITHOUT
+``donate_argnums`` doubles peak HBM: XLA must keep the input state
+alive while materializing the output state. On the 15.75 GB v5e-1 that
+is the difference between batch 8 fitting and an OOM ladder (bench.py's
+survivability rules exist because of exactly this). The rule fires only
+where the wrapped callable's signature is visible (a lambda or a
+same-file def) and its first parameter is state-like — opaque
+factory-call results (``jax.jit(make_train_step(...))``) are skipped
+rather than guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..finding import Finding
+from ..jitctx import Analysis, is_jit_callable, jit_call_kwargs
+
+RULE = "R4"
+NAME = "donation-discipline"
+
+_STATE_NAMES = {"state", "train_state", "opt_state", "optimizer_state"}
+
+
+def _first_param(fn: ast.AST) -> Optional[str]:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return None
+    params = args.posonlyargs + args.args
+    if not params:
+        return None
+    first = params[0]
+    if first.arg in ("self", "cls") and len(params) > 1:
+        first = params[1]
+    return first.arg
+
+
+def _is_statelike(name: Optional[str]) -> bool:
+    return name is not None and (
+        name in _STATE_NAMES or name.endswith("_state"))
+
+
+def _donates(kwargs) -> bool:
+    return "donate_argnums" in kwargs or "donate_argnames" in kwargs
+
+
+def check(a: Analysis) -> List[Finding]:
+    out: List[Finding] = []
+    # jax.jit(fn_or_lambda, ...) where the signature is visible
+    for call in a.jit_calls:
+        if not call.args:
+            continue
+        fn = call.args[0]
+        target: Optional[ast.AST] = None
+        if isinstance(fn, ast.Lambda):
+            target = fn
+        else:
+            target = a.resolve_def(fn, call)
+        if target is None:
+            continue
+        first = _first_param(target)
+        if _is_statelike(first) and not _donates(jit_call_kwargs(call)):
+            out.append(Finding(
+                a.path, call.lineno, call.col_offset, RULE, NAME,
+                f"jit wraps a function whose first parameter "
+                f"'{first}' looks like a train/optimizer state but "
+                "passes no donate_argnums — the old state stays live "
+                "and peak HBM doubles; add donate_argnums=(0,) (or "
+                "donate_argnames)"))
+    # @jax.jit-decorated defs with a state-like first parameter
+    for node in ast.walk(a.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if not is_jit_callable(dec):
+                continue
+            kwargs = (jit_call_kwargs(dec)
+                      if isinstance(dec, ast.Call) else {})
+            first = _first_param(node)
+            if _is_statelike(first) and not _donates(kwargs):
+                out.append(Finding(
+                    a.path, node.lineno, node.col_offset, RULE, NAME,
+                    f"@jit function '{node.name}' takes state-like "
+                    f"first parameter '{first}' without "
+                    "donate_argnums — add donate_argnums=(0,) or "
+                    "rename if it is not a consumed state"))
+    return out

@@ -1,0 +1,49 @@
+"""R6 exit-code-discipline.
+
+Runbooks and drivers branch on exit codes: ``WEDGED_EXIT_CODE`` (3,
+utils/watchdog.py) means "backend wedged — re-probe, don't sleep out
+your timeout". The round-5 advisor caught bench.py exiting 2 for the
+SAME failure mode, splitting one condition across two codes. Any raw
+integer to ``os._exit`` (and any distinctive code >= 2 to
+``sys.exit``) must be a named, shared constant; ``sys.exit(0)`` /
+``sys.exit(1)`` stay the conventional success/failure idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..finding import Finding
+from ..jitctx import Analysis, dotted
+
+RULE = "R6"
+NAME = "exit-code-discipline"
+
+_EXITS = {"os._exit", "sys.exit", "exit", "_exit"}
+
+
+def check(a: Analysis) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(a.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name not in _EXITS or not node.args:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, int)
+                and not isinstance(arg.value, bool)):
+            continue
+        code = arg.value
+        hard = name.endswith("_exit")
+        if hard or code >= 2:
+            out.append(Finding(
+                a.path, node.lineno, node.col_offset, RULE, NAME,
+                f"{name}({code}) uses a raw integer exit code — "
+                "runbooks branch on these; use the shared named "
+                "constant (e.g. raft_tpu.utils.watchdog."
+                "WEDGED_EXIT_CODE) so one failure mode maps to one "
+                "code"))
+    return out

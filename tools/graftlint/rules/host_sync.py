@@ -1,0 +1,85 @@
+"""R1 host-sync-in-hot-path.
+
+A host sync (``block_until_ready``, ``jax.device_get``, ``np.asarray``,
+``float(...)``/``int(...)``, ``.item()``) inside a jit-traced body is a
+trace-time error waiting to happen; inside the training/bench step loop
+it serializes host and device every iteration — on a remote backend that
+caps the loop at ~1/RTT steps/s regardless of how fast the chip is
+(BENCH_NOTES.md round 5 measured 0.72 steps/s against a ~3 steps/s
+device from exactly this).
+
+Hot-loop findings are limited to syncs that run UNCONDITIONALLY in the
+loop body: a fetch guarded by ``if step % sum_freq == ...`` is the
+sanctioned periodic-flush pattern (trainer.flush_metrics), not a bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..finding import Finding
+from ..jitctx import Analysis, dotted
+
+RULE = "R1"
+NAME = "host-sync-in-hot-path"
+
+#: full dotted names that force a device->host round trip
+SYNC_CALLS = {
+    "jax.block_until_ready", "jax.device_get",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+}
+#: method names that do the same on any receiver
+SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+#: builtins that concretize an array value
+SYNC_BUILTINS = {"float", "int", "bool"}
+
+
+def _sync_reason(node: ast.Call) -> str:
+    name = dotted(node.func)
+    if name in SYNC_CALLS:
+        return f"{name}(...)"
+    if (isinstance(node.func, ast.Attribute)
+            and node.func.attr in SYNC_METHODS):
+        return f".{node.func.attr}()"
+    if (isinstance(node.func, ast.Name)
+            and node.func.id in SYNC_BUILTINS and node.args
+            and not isinstance(node.args[0], ast.Constant)):
+        return f"{node.func.id}(...) on a non-literal"
+    return ""
+
+
+def check(a: Analysis) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(a.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        reason = _sync_reason(node)
+        if not reason:
+            continue
+        if a.in_jitted_body(node):
+            out.append(Finding(
+                a.path, node.lineno, node.col_offset, RULE, NAME,
+                f"host sync {reason} inside a jit-traced body — "
+                "concretizes a tracer (or silently falls back to "
+                "trace-time constants)"))
+            continue
+        loop = a.enclosing_hot_loop(node)
+        if loop is not None and not a.under_if_within(node, loop):
+            if reason.startswith(("np.", "numpy.")):
+                # np.array/asarray on a HOST value is not a device
+                # sync, but it is host work serialized into the step
+                # loop (and a D2H fetch when the value is on device)
+                detail = ("materializes on host every iteration — a "
+                          "D2H sync if the value is a device array, "
+                          "stalled dispatch either way; hoist it out "
+                          "of the loop or guard it on a cadence")
+            else:
+                detail = ("serializes host and device every "
+                          "iteration; fetch periodically under an "
+                          "`if step % freq` guard instead")
+            out.append(Finding(
+                a.path, node.lineno, node.col_offset, RULE, NAME,
+                f"unconditional host sync {reason} inside a loop that "
+                f"drives a jit-compiled step — {detail}"))
+    return out

@@ -1,0 +1,162 @@
+"""R5 resource-lifecycle.
+
+The round-5 advisor finding this rule generalizes: a watchdog daemon
+armed with ``.start()`` whose ``.stop()`` runs only on the
+normal-return path survives any exception — and later hard-kills the
+host process with ``os._exit`` once its heartbeat goes stale
+(ADVICE.md, trainer.py). Two checks:
+
+- **paired start/stop**: if a function both ``X.start()``s and
+  ``X.stop()``s the same object, at least one ``X.stop()`` must sit in
+  a ``finally:`` suite (or the start must itself be inside a ``try``
+  whose finally stops it) so the exception path disarms the resource;
+- **daemon threads**: arming ``threading.Thread(..., daemon=True)`` in
+  a function with no ``finally:`` at all leaks a live thread past every
+  exception. Lifecycle-owning classes (defining ``stop``/``close``/
+  ``shutdown``/``__exit__``) are exempt — the caller-side check above
+  covers their users.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..finding import Finding
+from ..jitctx import Analysis, dotted
+
+RULE = "R5"
+NAME = "resource-lifecycle"
+
+_STOPPISH = {"stop", "close", "shutdown", "__exit__", "join"}
+
+
+def _recv_name(call: ast.Call) -> Optional[str]:
+    """Dotted receiver of a method call: ``a.b.start()`` -> "a.b"."""
+    if isinstance(call.func, ast.Attribute):
+        return dotted(call.func.value)
+    return None
+
+
+def _is_daemon_thread_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    if dotted(node.func) not in ("threading.Thread", "Thread"):
+        return False
+    for kw in node.keywords:
+        if (kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True):
+            return True
+    return False
+
+
+def _class_owns_lifecycle(cls: Optional[ast.ClassDef]) -> bool:
+    if cls is None:
+        return False
+    names = {n.name for n in cls.body
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    return bool(names & _STOPPISH)
+
+
+def _finally_covers_arming(a, fn: ast.AST, call: ast.Call) -> bool:
+    """A try/finally only excuses arming a daemon if it can actually
+    shut it down: the arming must be inside the try, or the try must
+    come after it (the loader.py pattern — threads started, then the
+    consume loop's finally signals stop). A finally that completed
+    BEFORE the arming covers nothing."""
+    cur = a.parents.get(call)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, ast.Try) and cur.finalbody:
+            return True
+        cur = a.parents.get(cur)
+    # same-scope walk only: a finally inside a NESTED function can
+    # never run the outer thread's shutdown
+    todo = list(ast.iter_child_nodes(fn))
+    while todo:
+        node = todo.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if (isinstance(node, ast.Try) and node.finalbody
+                and node.lineno >= call.lineno):
+            return True
+        todo.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def check(a: Analysis) -> List[Finding]:
+    out: List[Finding] = []
+    # group method calls per enclosing function scope
+    per_scope: Dict[ast.AST, List[ast.Call]] = {}
+    for node in ast.walk(a.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            per_scope.setdefault(a.scope_of(node), []).append(node)
+
+    for scope, calls in per_scope.items():
+        starts: Dict[str, List[ast.Call]] = {}
+        stops: Dict[str, List[ast.Call]] = {}
+        for call in calls:
+            recv = _recv_name(call)
+            if recv is None:
+                continue
+            if call.func.attr == "start":
+                starts.setdefault(recv, []).append(call)
+            elif call.func.attr == "stop":
+                stops.setdefault(recv, []).append(call)
+        for recv, start_calls in starts.items():
+            if recv not in stops:
+                continue
+            if any(a.in_finally(s) for s in stops[recv]):
+                continue
+            for s in start_calls:
+                out.append(Finding(
+                    a.path, s.lineno, s.col_offset, RULE, NAME,
+                    f"{recv}.start() is armed but every {recv}.stop() "
+                    "is on the normal-return path only — an exception "
+                    "leaves the resource live (a watchdog will later "
+                    "hard-kill the process); move stop() into a "
+                    "try/finally"))
+
+    # daemon-thread arming outside any try/finally
+    daemon_names: Dict[ast.AST, Set[str]] = {}
+    for node in ast.walk(a.tree):
+        if isinstance(node, ast.Assign) and _is_daemon_thread_ctor(
+                node.value):
+            for tgt in node.targets:
+                name = tgt.id if isinstance(tgt, ast.Name) else dotted(tgt)
+                if name:
+                    daemon_names.setdefault(
+                        a.scope_of(node), set()).add(name)
+
+    def _flag_daemon(call: ast.Call) -> None:
+        scope = a.scope_of(call)
+        if isinstance(scope, ast.Module):
+            return  # module-level arming is process-lifetime by intent
+        if _class_owns_lifecycle(a.enclosing_class(scope)):
+            return
+        if _finally_covers_arming(a, scope, call):
+            return
+        out.append(Finding(
+            a.path, call.lineno, call.col_offset, RULE, NAME,
+            "daemon thread armed in a function with no try/finally — "
+            "an exception after this point leaks a live watcher "
+            "thread; arm it inside try/finally (or own it in a class "
+            "with a stop())"))
+
+    for node in ast.walk(a.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "start"):
+            continue
+        if _is_daemon_thread_ctor(node.func.value):
+            _flag_daemon(node)  # threading.Thread(daemon=True).start()
+            continue
+        recv = _recv_name(node)
+        if recv is None:
+            continue
+        for scope in a.scope_chain(node):
+            if recv in daemon_names.get(scope, set()):
+                _flag_daemon(node)
+                break
+    return out

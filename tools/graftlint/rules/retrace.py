@@ -1,0 +1,114 @@
+"""R3 retrace-hazard.
+
+``jax.jit`` caches compiled programs on the IDENTITY of the wrapped
+callable plus the hash of static arguments. Calling ``jax.jit`` inside
+a loop body (or on a fresh ``lambda`` per iteration) creates a new
+callable each pass, so every iteration pays a full trace+compile —
+multi-minute on a remote TPU backend. Passing an unhashable value
+(list/dict/set) in a ``static_argnums`` position raises at call time,
+after the code already shipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..finding import Finding
+from ..jitctx import Analysis, dotted, jit_call_kwargs
+
+RULE = "R3"
+NAME = "retrace-hazard"
+
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp,
+                   ast.GeneratorExp)
+
+
+def _static_positions(call: ast.Call) -> Tuple[int, ...]:
+    """Literal int positions named by ``static_argnums``, else ()."""
+    kw = jit_call_kwargs(call).get("static_argnums")
+    if kw is None:
+        return ()
+    nodes = kw.elts if isinstance(kw, (ast.Tuple, ast.List)) else [kw]
+    pos = []
+    for n in nodes:
+        if isinstance(n, ast.Constant) and isinstance(n.value, int):
+            pos.append(n.value)
+    return tuple(pos)
+
+
+def _in_comprehension(a: Analysis, node: ast.AST) -> bool:
+    cur = a.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, _COMPREHENSIONS):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return False
+        cur = a.parents.get(cur)
+    return False
+
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp)
+
+
+def check(a: Analysis) -> List[Finding]:
+    out: List[Finding] = []
+    # (a) jit created inside a loop or comprehension body
+    for call in a.jit_calls:
+        if (a.enclosing_loop_same_scope(call) is not None
+                or _in_comprehension(a, call)):
+            what = ("a fresh lambda" if call.args
+                    and isinstance(call.args[0], ast.Lambda)
+                    else "the wrapped callable")
+            out.append(Finding(
+                a.path, call.lineno, call.col_offset, RULE, NAME,
+                f"jax.jit called inside a loop: {what} is a new cache "
+                "key every iteration, so each pass re-traces and "
+                "re-compiles — hoist the jit out of the loop"))
+        # (b) direct-invoke jit(f, static_argnums=...)(args...) with an
+        # unhashable literal in a static position
+        parent = a.parents.get(call)
+        if isinstance(parent, ast.Call) and parent.func is call:
+            for pos in _static_positions(call):
+                if (pos < len(parent.args)
+                        and isinstance(parent.args[pos], _UNHASHABLE)):
+                    # anchor to the CALL line, not the argument's own
+                    # line — pragmas live on the statement's first line
+                    out.append(Finding(
+                        a.path, parent.lineno, parent.col_offset,
+                        RULE, NAME,
+                        f"argument {pos} is marked static but is an "
+                        "unhashable literal — jit static args are "
+                        "cache keys and must hash"))
+    # (c) call sites of names bound to jit(..., static_argnums=...)
+    static_by_name: Dict[Tuple[ast.AST, str], Tuple[int, ...]] = {}
+    for scope, bound in a.jit_bound.items():
+        for name, call in bound.items():
+            pos = _static_positions(call)
+            if pos:
+                static_by_name[(scope, name)] = pos
+    if static_by_name:
+        for node in ast.walk(a.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            for scope in a.scope_chain(node):
+                pos = static_by_name.get((scope, name))
+                if pos is None:
+                    continue
+                for p in pos:
+                    if (p < len(node.args)
+                            and isinstance(node.args[p], _UNHASHABLE)):
+                        out.append(Finding(
+                            a.path, node.lineno, node.col_offset,
+                            RULE, NAME,
+                            f"argument {p} of {name}(...) is static "
+                            "but unhashable (list/dict/set) — this "
+                            "raises at call time; pass a tuple or "
+                            "hashable config"))
+                break
+    return out

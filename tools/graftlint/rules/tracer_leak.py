@@ -1,0 +1,53 @@
+"""R2 tracer-leak.
+
+``np.*`` math on a traced value inside a jit body either crashes at
+trace time or — worse — silently evaluates once on trace-time constants
+and bakes the result into the compiled program. Python ``print`` inside
+a jit body runs at TRACE time only: it prints tracer reprs during the
+first call and nothing ever again, which reads like a working log line
+until retracing stops. Use ``jnp.*`` and ``jax.debug.print`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..finding import Finding
+from ..jitctx import Analysis, dotted
+
+RULE = "R2"
+NAME = "tracer-leak"
+
+#: np attributes that are fine at trace time: dtypes, constants, and
+#: introspection that works on tracers
+_NP_ALLOWED = {
+    "float16", "float32", "float64", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_", "dtype", "finfo",
+    "iinfo", "shape", "ndim", "pi", "inf", "nan", "newaxis", "e",
+}
+#: handled (and better diagnosed) by R1
+_NP_R1 = {"asarray", "array"}
+
+
+def check(a: Analysis) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(a.tree):
+        if not isinstance(node, ast.Call) or not a.in_jitted_body(node):
+            continue
+        name = dotted(node.func)
+        if name and name.split(".", 1)[0] in ("np", "numpy"):
+            attr = name.split(".")[-1]
+            if attr not in _NP_ALLOWED and attr not in _NP_R1:
+                out.append(Finding(
+                    a.path, node.lineno, node.col_offset, RULE, NAME,
+                    f"{name}(...) inside a jit-traced body runs on the "
+                    "host at trace time — use the jnp equivalent so it "
+                    "stays in the compiled program"))
+        elif isinstance(node.func, ast.Name) and node.func.id == "print":
+            out.append(Finding(
+                a.path, node.lineno, node.col_offset, RULE, NAME,
+                "print(...) inside a jit-traced body fires at trace "
+                "time only (tracer reprs once, then silence) — use "
+                "jax.debug.print for runtime values"))
+    return out

@@ -18,7 +18,11 @@ mkdir -p "$MARK"
 log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
 
 if [ ! -e "$MARK/trace_attr" ]; then
-    bash tools/chip_probe.sh 120 || exit 1
+    # a probe-failed pass must leave a trace in $OUT (mirrors the
+    # FAILED trace_attr path) — a silent exit 1 reads as "never ran"
+    bash tools/chip_probe.sh 120 \
+        || { log "FAILED chip_probe (probe failed, skipping trace_attr)"; \
+             exit 1; }
     log "begin trace_attr (profile_step + XLA dump at bench defaults)"
     rm -rf /tmp/trace_r5c /tmp/hlo_r5c
     if timeout 900 env \
