@@ -210,6 +210,7 @@ _DEFAULTS_SCHEMA = {
     "fused_loss": lambda v: isinstance(v, bool),
     "scan_unroll": lambda v: (isinstance(v, int)
                               and not isinstance(v, bool) and v >= 1),
+    "gru_impl": lambda v: v in ("xla", "fused"),
 }
 
 
@@ -311,6 +312,14 @@ def _build_parser(suppress=False):
     p.add_argument("--corr-impl", default=default(None),
                    choices=["gather", "onehot", "onehot_t", "softsel", "softsel_t", "pallas"],
                    help="override RAFTConfig.corr_impl")
+    p.add_argument("--gru-impl", default=default(None),
+                   choices=["xla", "fused"],
+                   help="update-block implementation (RAFTConfig."
+                        "gru_impl): 'fused' = lane-major scan-body "
+                        "motion encoder + SepConvGRU with Pallas "
+                        "gate/blend epilogues; promotion to default is "
+                        "decided by these whole-step rungs, never by "
+                        "isolated kernel benches")
     p.add_argument("--fused-loss", action=argparse.BooleanOptionalAction,
                    default=default(False),
                    help="sequence loss in the upsampler's subpixel domain "
@@ -442,6 +451,8 @@ def main():
             overrides["remat_policy"] = args.remat_policy
         if args.scan_unroll != 1:
             overrides["scan_unroll"] = args.scan_unroll
+        if args.gru_impl:
+            overrides["gru_impl"] = args.gru_impl
         try:
             value = run(batch_size, args.remat, args.warmup, args.steps,
                         overrides, tuple(args.hw),
@@ -484,6 +495,8 @@ def main():
             tag += "_fusedloss"
         if args.scan_unroll != 1:
             tag += f"_unroll{args.scan_unroll}"
+        if args.gru_impl:
+            tag += f"_gru{args.gru_impl}"
         emit(f"raft_basic_train_{shape_tag}_bf16_b{batch_size}"
              f"_iters{ITERS}_1chip{tag}", value)
         return 0

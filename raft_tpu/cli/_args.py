@@ -26,11 +26,18 @@ def add_corr_args(p: argparse.ArgumentParser) -> None:
                    help="refinement-loop lax.scan unroll factor; >1 lets "
                         "XLA pipeline across iteration boundaries (see "
                         "RAFTConfig.scan_unroll)")
+    p.add_argument("--gru_impl", "--gru-impl", default=None,
+                   choices=["xla", "fused"],
+                   help="update-block implementation: 'fused' runs the "
+                        "scan-body motion encoder + SepConvGRU lane-major "
+                        "with Pallas gate/blend epilogues (see "
+                        "RAFTConfig.gru_impl)")
 
 
 def corr_overrides(args: argparse.Namespace) -> dict:
     """RAFTConfig kwargs for the flags :func:`add_corr_args` added."""
     return {k: v for k, v in (("corr_impl", args.corr_impl),
                               ("corr_dtype", args.corr_dtype),
-                              ("scan_unroll", args.scan_unroll))
+                              ("scan_unroll", args.scan_unroll),
+                              ("gru_impl", args.gru_impl))
             if v is not None}

@@ -99,6 +99,24 @@ class RAFTConfig:
     # the memory win at a fraction of the recompute, since the body is
     # conv/GEMM-dominated
     remat_policy: str = "full"
+    # update-block implementation for the refinement scan body: 'xla'
+    # keeps the reference-shaped NHWC convs (the parity surface); 'fused'
+    # runs the basic model's motion encoder + SepConvGRU in the
+    # lane-major (B, H·W, C) layout — each 1x5/5x1/3x3 conv becomes a
+    # per-tap shifted GEMM accumulation whose operands put the whole
+    # 46x62 spatial plane on sublanes and the 128 channels on lanes
+    # (tile-dense MXU work instead of a fragmented small conv; tiny-cin
+    # taps like the 7x7-on-flow stay broadcast FMAs per PROFILE lesson
+    # 5) — with the sigmoid/tanh gate math and the (1-z)*h + z*q blend
+    # fused into Pallas epilogues (kernels/gru_pallas, interpret-mode
+    # fallback off-TPU) so gate intermediates stop round-tripping HBM
+    # 12x per step. Parameter tree and fp32 math are identical to 'xla'
+    # (oracle-pinned in tests/test_gru_fused.py); checkpoints are
+    # interchangeable. Default stays 'xla' until the whole-step A/B
+    # rungs (tools/onchip_round6.sh g_gru* -> BENCH_DEFAULTS.json) show
+    # a measured win — isolated kernel benches steered the repo wrong
+    # for two rounds (PROFILE round 5, softsel) and do not promote.
+    gru_impl: str = "xla"
     # lax.scan unroll factor for the refinement loop: >1 replicates the
     # iteration body so XLA can software-pipeline across iteration
     # boundaries (overlap iteration i's GRU convs with i+1's lookup
@@ -128,6 +146,17 @@ class RAFTConfig:
                 "memory-efficient alternate path is selected by "
                 "alternate_corr=True, with corr_impl picking its "
                 "XLA/pallas backend)")
+        if self.gru_impl not in ("xla", "fused"):
+            raise ValueError(
+                f"gru_impl={self.gru_impl!r}: choose 'xla' (reference "
+                "NHWC update block) or 'fused' (lane-major scan-body "
+                "path with Pallas gate/blend epilogues)")
+        if self.gru_impl == "fused" and self.small:
+            raise ValueError(
+                "gru_impl='fused' covers the basic model's "
+                "BasicMotionEncoder + SepConvGRU; the small model's "
+                "3x3 ConvGRU has no fused path — drop one of the two "
+                "settings")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy={self.remat_policy!r}: choose 'full' or "

@@ -1,0 +1,215 @@
+"""Pallas TPU kernels: fused ConvGRU gate math and blend epilogue.
+
+The refinement scan runs the SepConvGRU twice per iteration (horizontal
+then vertical), and each half's elementwise tail — ``z = σ(zl)``,
+``r = σ(rl)``, ``r·h``, then ``h' = (1-z)·h + z·tanh(ql)`` — is a chain
+of small VPU ops between the gate convs. Left to XLA inside the scan
+body those intermediates (z, r, r·h, tanh) round-trip HBM between the
+conv fusions 12× fwd + 12× bwd per step, at the 46×62-spatial shapes
+PROFILE round 5 measured running 20–80 GB/s effective. These kernels
+fuse each tail into ONE pass over the operands:
+
+- :func:`gru_gates`: ``(zl, rl, h) -> (z, r·h)`` — both sigmoids and the
+  reset-gate product in one read of the three inputs. ``z`` feeds the
+  blend; ``r·h`` feeds the candidate conv's input concat.
+- :func:`gru_blend`: ``(z, h, ql) -> (1-z)·h + z·tanh(ql)`` — the tanh
+  and the convex blend in one pass; no separate q tensor ever lands in
+  HBM.
+
+Both are elementwise over ``(B, N, C)`` lane-major operands (N = H·W on
+sublanes, C on lanes — the fused update block's native layout, see
+``models.layers._apply_conv_lane_major``), gridded over row tiles so
+VMEM holds only a slab at a time.
+
+Training support: each op is a ``jax.custom_vjp`` whose backward is a
+second fused kernel recomputing the activations from the saved INPUTS
+(elementwise recompute is cheaper than storing z/r/tanh per iteration —
+the scan would otherwise stack them across all 12 iterations):
+
+- gates: ``dzl = dz·z·(1-z)``, ``drl = drh·h·r·(1-r)``, ``dh = drh·r``
+- blend: ``dz = g·(tanh(ql) - h)``, ``dh = g·(1-z)``,
+  ``dql = g·z·(1-tanh²(ql))``
+
+Off-TPU the kernels run in interpret mode (pure XLA), same loud-warning
+contract as ``corr_pallas`` — a trace on a non-TPU host bakes the
+interpret path into any export artifact, and that must not be silent.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas import is gated so CPU-only installs still work
+    from jax.experimental import pallas as pl
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+from raft_tpu.kernels.corr_pallas import (_fallback_interpret,  # noqa: F401
+                                          pallas_available)
+
+# interpret mode runs the kernels in pure XLA — forced by CPU tests via
+# monkeypatch; off-TPU backends fall back automatically (see
+# corr_pallas._interpret for why — and the fused update block must run
+# end-to-end on the CPU host for the oracle parity tests)
+_INTERPRET = False
+
+#: max rows (of the flattened H·W axis) per grid step. Elementwise
+#: kernels with ≤5 operands at 128 lanes: 512 rows × 128 lanes × 4 B =
+#: 256 KB per buffer, ~4 MB double-buffered worst case — far under the
+#: 16 MB VMEM ceiling, large enough that the DMA engine streams.
+_ROWS = 512
+#: smallest acceptable exact-divisor tile before padding wins: below
+#: this the grid gets long and each DMA small, and one padded copy per
+#: operand is cheaper than hundreds of sliver transfers.
+_MIN_ROWS = 64
+
+
+def _interpret() -> bool:
+    return _INTERPRET or _fallback_interpret()
+
+
+def _row_tile(N):
+    """(rows per grid step, rows of padding) for an N-row operand.
+
+    Prefers the largest EXACT divisor of N within the VMEM budget: the
+    kernels exist to cut HBM round trips, so padding every operand with
+    a jnp.pad copy on the hot path (as a fixed power-of-two tile would
+    at e.g. the 46·62 = 2852-row production geometry — tile 124 divides
+    it) must be the exception, not the rule. Falls back to a padded
+    ``_ROWS`` tile only when N is near-prime and the best divisor would
+    shred the grid into sliver DMAs.
+    """
+    if N <= _ROWS:
+        return N, 0
+    best = max(r for r in range(1, _ROWS + 1) if N % r == 0)
+    if best >= _MIN_ROWS:
+        return best, 0
+    return _ROWS, (-N) % _ROWS
+
+
+def _gates_kernel(zl_ref, rl_ref, h_ref, z_ref, rh_ref):
+    zl = zl_ref[...]
+    rl = rl_ref[...]
+    h = h_ref[...]
+    z_ref[...] = jax.nn.sigmoid(zl)
+    rh_ref[...] = jax.nn.sigmoid(rl) * h
+
+
+def _gates_bwd_kernel(zl_ref, rl_ref, h_ref, dz_ref, drh_ref,
+                      dzl_ref, drl_ref, dh_ref):
+    z = jax.nn.sigmoid(zl_ref[...])
+    r = jax.nn.sigmoid(rl_ref[...])
+    dz = dz_ref[...]
+    drh = drh_ref[...]
+    dzl_ref[...] = dz * z * (1.0 - z)
+    drl_ref[...] = drh * h_ref[...] * r * (1.0 - r)
+    dh_ref[...] = drh * r
+
+
+def _blend_kernel(z_ref, h_ref, ql_ref, out_ref):
+    z = z_ref[...]
+    out_ref[...] = (1.0 - z) * h_ref[...] + z * jnp.tanh(ql_ref[...])
+
+
+def _blend_bwd_kernel(z_ref, h_ref, ql_ref, g_ref,
+                      dz_ref, dh_ref, dql_ref):
+    z = z_ref[...]
+    g = g_ref[...]
+    t = jnp.tanh(ql_ref[...])
+    dz_ref[...] = g * (t - h_ref[...])
+    dh_ref[...] = g * (1.0 - z)
+    dql_ref[...] = g * z * (1.0 - t * t)
+
+
+def _tiled_call(kernel, inputs, n_out):
+    """Run an elementwise kernel over same-shaped (B, N, C) operands,
+    gridded in row tiles; outputs mirror the first input's shape/dtype.
+
+    The row tile exactly divides N when a reasonable divisor exists
+    (see :func:`_row_tile`); otherwise N is padded up to a tile multiple
+    (elementwise: the pad rows compute garbage that the final slice
+    drops), so any N works.
+    """
+    B, N, C = inputs[0].shape
+    dt = inputs[0].dtype
+    rows, n_pad = _row_tile(N)
+    if n_pad:
+        inputs = [jnp.pad(a, ((0, 0), (0, n_pad), (0, 0))) for a in inputs]
+    Np = N + n_pad
+    spec = pl.BlockSpec((1, rows, C), lambda b, t: (b, t, 0))
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, Np // rows),
+        in_specs=[spec] * len(inputs),
+        out_specs=[spec] * n_out,
+        out_shape=[jax.ShapeDtypeStruct((B, Np, C), dt)] * n_out,
+        interpret=_interpret(),
+    )(*inputs)
+    if n_pad:
+        out = [o[:, :N] for o in out]
+    return tuple(out)
+
+
+@jax.custom_vjp
+def gru_gates(zl, rl, h):
+    """Fused update/reset-gate epilogue: ``(σ(zl), σ(rl)·h)``.
+
+    All operands (B, N, C) lane-major, same dtype. Returns ``(z, rh)``:
+    ``z`` for :func:`gru_blend`, ``rh`` for the candidate conv's input.
+    """
+    return _tiled_call(_gates_kernel, [zl, rl, h], n_out=2)
+
+
+def _gates_fwd(zl, rl, h):
+    return gru_gates(zl, rl, h), (zl, rl, h)
+
+
+def _gates_bwd(res, cts):
+    zl, rl, h = res
+    dz, drh = cts
+    return _tiled_call(_gates_bwd_kernel, [zl, rl, h, dz, drh], n_out=3)
+
+
+gru_gates.defvjp(_gates_fwd, _gates_bwd)
+
+
+@jax.custom_vjp
+def gru_blend(z, h, ql):
+    """Fused candidate/blend epilogue: ``(1-z)·h + z·tanh(ql)``.
+
+    ``ql`` is the candidate conv's PRE-tanh output — the tanh runs in
+    here so the q tensor never materializes in HBM.
+    """
+    (out,) = _tiled_call(_blend_kernel, [z, h, ql], n_out=1)
+    return out
+
+
+def _blend_fwd(z, h, ql):
+    return gru_blend(z, h, ql), (z, h, ql)
+
+
+def _blend_bwd(res, g):
+    z, h, ql = res
+    return _tiled_call(_blend_bwd_kernel, [z, h, ql, g], n_out=3)
+
+
+gru_blend.defvjp(_blend_fwd, _blend_bwd)
+
+
+def gru_cell_lane_major(h, zl, rl, ql_fn):
+    """One GRU half in the fused formulation.
+
+    ``ql_fn(rh)`` must produce the candidate conv's pre-activation from
+    the fused ``r·h`` (the caller owns the conv so the parameter tree
+    stays the update block's). Shared by both SepConvGRU directions.
+    """
+    z, rh = gru_gates(zl, rl, h)
+    ql = ql_fn(rh)
+    return gru_blend(z, h, ql)
+
+
+__all__ = ["gru_gates", "gru_blend", "gru_cell_lane_major",
+           "pallas_available"]

@@ -95,6 +95,23 @@ def _apply_conv(x, kernel, bias, strides, padding, dtype):
     return y
 
 
+def _concat_pair_weights(conv_a: "TorchConv", conv_b: "TorchConv", in_feat):
+    """Declare two same-geometry TorchConvs' params and return them
+    concatenated on the output-channel axis — the ONE definition of the
+    pair-fusion contract, shared by the NHWC and lane-major pair paths
+    (a change to fusability must not silently diverge them)."""
+    assert (conv_a.kernel_size == conv_b.kernel_size
+            and conv_a.strides == conv_b.strides
+            and conv_a.padding == conv_b.padding
+            and conv_a.dtype == conv_b.dtype
+            and conv_a.use_bias == conv_b.use_bias), "fusable convs must agree"
+    ka, ba = conv_a.weights(in_feat)
+    kb, bb = conv_b.weights(in_feat)
+    kernel = jnp.concatenate([ka, kb], axis=-1)
+    bias = jnp.concatenate([ba, bb]) if ba is not None else None
+    return kernel, bias
+
+
 def fused_conv_pair(conv_a: "TorchConv", conv_b: "TorchConv", x):
     """Apply two same-geometry TorchConvs to the SAME input as one
     double-width conv (kernels/biases concatenated on the output-channel
@@ -110,18 +127,104 @@ def fused_conv_pair(conv_a: "TorchConv", conv_b: "TorchConv", x):
     lever. Param trees stay those of the two separate convs — checkpoint
     conversion (tools/convert) is unaffected.
     """
-    assert (conv_a.kernel_size == conv_b.kernel_size
-            and conv_a.strides == conv_b.strides
-            and conv_a.padding == conv_b.padding
-            and conv_a.dtype == conv_b.dtype
-            and conv_a.use_bias == conv_b.use_bias), "fusable convs must agree"
-    in_feat = x.shape[-1]
-    ka, ba = conv_a.weights(in_feat)
-    kb, bb = conv_b.weights(in_feat)
-    kernel = jnp.concatenate([ka, kb], axis=-1)
-    bias = jnp.concatenate([ba, bb]) if ba is not None else None
+    kernel, bias = _concat_pair_weights(conv_a, conv_b, x.shape[-1])
     y = _apply_conv(x, kernel, bias, conv_a.strides, conv_a.padding,
                     conv_a.dtype)
+    return y[..., :conv_a.features], y[..., conv_a.features:]
+
+
+# Below this input width the per-tap contraction is expressed as
+# broadcast FMAs instead of a dot: a cin of 2 (the 7x7-on-flow conv) pads
+# its contraction dim to the MXU tile and pays layout assignment around
+# the dot for no arithmetic win — PROFILE lesson 5 (a tiny contraction
+# axis is not a GEMM; let the VPU stream).
+_FMA_MAX_CIN = 8
+
+
+def _apply_conv_lane_major(x, kernel, bias, hw, padding, dtype):
+    """Stride-1 torch-padded conv in the lane-major ``(B, H·W, C)`` layout.
+
+    The conv is a per-tap shifted GEMM accumulation: for each of the
+    kh·kw kernel taps, the symmetrically padded input plane is shifted by
+    the tap offset (a static slice), flattened back to ``(B, H·W, cin)``,
+    and contracted against that tap's ``(cin, cout)`` kernel slice. Each
+    output channel's dot product sums the same terms as
+    ``conv_general_dilated`` — values match the NHWC conv to fp32
+    accumulation-order noise — but every operand the MXU sees is
+    ``(H·W, C)``-minor: the whole spatial plane on sublanes, channels on
+    lanes, no per-op halo fragmentation. This is the scan-body layout
+    lever for the 46x62-spatial GRU/motion-encoder convs that run
+    latency-bound as small NHWC convs (PROFILE round 5 tail).
+
+    ``x``: (B, H·W, cin); ``hw``: the (H, W) the flat axis factors into;
+    ``kernel``: (kh, kw, cin, cout) HWIO as :class:`TorchConv` declares;
+    ``padding``: torch-style symmetric (ph, pw). Returns (B, H·W, cout).
+    """
+    H, W = hw
+    kh, kw, cin, cout = kernel.shape
+    ph, pw = padding
+    B, N, _ = x.shape
+    assert N == H * W, (N, hw)
+    assert x.shape[-1] == cin, (x.shape, kernel.shape)
+    # the NHWC-conv equivalence below holds only for 'same'-shaped
+    # geometry (stride 1, odd kernel, p = k//2): anything else changes
+    # the output extent and this formulation would silently crop it
+    assert kh == 2 * ph + 1 and kw == 2 * pw + 1, (
+        "lane-major path covers torch-'same' convs only", kernel.shape,
+        padding)
+    x = x.astype(dtype)
+    kernel = kernel.astype(dtype)
+    if (kh, kw) == (1, 1):
+        # pointwise conv: already one tile-dense GEMM, no shifts needed
+        y = jnp.dot(x, kernel[0, 0])
+    else:
+        # reshape to the plane (free on a contiguous row-major layout),
+        # pad once, slice per tap
+        xp = jnp.pad(x.reshape(B, H, W, cin),
+                     ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+        y = None
+        for dy in range(kh):
+            for dx in range(kw):
+                tap = jax.lax.slice(
+                    xp, (0, dy, dx, 0),
+                    (B, dy + H, dx + W, cin)).reshape(B, N, cin)
+                if cin <= _FMA_MAX_CIN:
+                    t = tap[..., 0:1] * kernel[dy, dx, 0]
+                    for c in range(1, cin):
+                        t = t + tap[..., c:c + 1] * kernel[dy, dx, c]
+                else:
+                    t = jnp.dot(tap, kernel[dy, dx])
+                y = t if y is None else y + t
+    if bias is not None:
+        y = y + bias.astype(dtype)
+    return y
+
+
+def conv_lane_major(conv: "TorchConv", x, hw):
+    """Apply a :class:`TorchConv` submodule to lane-major input.
+
+    Declares the conv's parameters through ``TorchConv.weights`` — the
+    tree is identical whether the module is applied NHWC via
+    ``__call__`` or lane-major here, so the fused update block shares
+    checkpoints with the reference-shaped one (the ``fused_conv_pair``
+    contract, extended to a layout change).
+    """
+    kernel, bias = conv.weights(x.shape[-1])
+    assert conv.strides == (1, 1), "lane-major path is stride-1 only"
+    return _apply_conv_lane_major(x, kernel, bias, hw, conv.padding,
+                                  conv.dtype)
+
+
+def conv_pair_lane_major(conv_a: "TorchConv", conv_b: "TorchConv", x, hw):
+    """Lane-major analog of :func:`fused_conv_pair`: two same-geometry
+    convs over the SAME input as one double-width tap contraction
+    (kernels/biases concatenated on the output-channel axis), returning
+    the pair of outputs. Halves the per-tap GEMM count for the GRU's z/r
+    gate pair, exactly as the NHWC fusion does for the conv count."""
+    assert conv_a.strides == (1, 1), "lane-major path is stride-1 only"
+    kernel, bias = _concat_pair_weights(conv_a, conv_b, x.shape[-1])
+    y = _apply_conv_lane_major(x, kernel, bias, hw, conv_a.padding,
+                               conv_a.dtype)
     return y[..., :conv_a.features], y[..., conv_a.features:]
 
 

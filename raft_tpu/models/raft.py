@@ -38,7 +38,8 @@ from raft_tpu.models.corr import (
     corr_lookup_softsel_t,
 )
 from raft_tpu.models.encoders import BasicEncoder, SmallEncoder
-from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
+from raft_tpu.models.update import (BasicUpdateBlock, FusedBasicUpdateBlock,
+                                    SmallUpdateBlock)
 from raft_tpu.ops.flow_ops import (
     convex_upsample_batched,
     convex_upsample_batched_raw,
@@ -67,7 +68,14 @@ class RAFT(nn.Module):
                                      dt)
             self.cnet = BasicEncoder(cfg.cnet_dim, cfg.cnet_norm, cfg.dropout,
                                      dt)
-            self.update_block = BasicUpdateBlock(cfg.hidden_dim, dt)
+            # gru_impl selects the scan-body implementation, never the
+            # parameters: both blocks declare the identical tree, so
+            # checkpoints and the whole-step A/B rungs swap freely
+            # (mirrors the corr_impl pattern; see RAFTConfig.gru_impl)
+            if cfg.gru_impl == "fused":
+                self.update_block = FusedBasicUpdateBlock(cfg.hidden_dim, dt)
+            else:
+                self.update_block = BasicUpdateBlock(cfg.hidden_dim, dt)
 
     def __call__(self, image1, image2, iters: int = 12,
                  flow_init: Optional[jax.Array] = None,

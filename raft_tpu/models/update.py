@@ -13,7 +13,8 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from raft_tpu.models.layers import TorchConv, fused_conv_pair
+from raft_tpu.models.layers import (TorchConv, conv_lane_major,
+                                    conv_pair_lane_major, fused_conv_pair)
 
 
 class FlowHead(nn.Module):
@@ -127,6 +128,117 @@ class BasicMotionEncoder(nn.Module):
         out = nn.relu(TorchConv(126, (3, 3), (1, 1), (1, 1), self.dtype,
                                 name="conv")(jnp.concatenate([cor, flo], -1)))
         return jnp.concatenate([out, flow.astype(out.dtype)], axis=-1)
+
+
+class FusedSepConvGRU(nn.Module):
+    """Lane-major SepConvGRU (``gru_impl='fused'``): same parameters and
+    fp32 math as :class:`SepConvGRU`, restructured for the TPU.
+
+    ``h``/``x`` arrive flattened ``(B, H·W, C)``; the 1x5/5x1 convs run
+    as per-tap shifted GEMM accumulations in that layout (see
+    ``layers._apply_conv_lane_major`` — the 46x62 spatial plane rides
+    sublanes instead of fragmenting into tile-padded small convs), the
+    z/r pair of each direction shares one double-width tap contraction,
+    and the elementwise gate/blend tails run in the fused Pallas
+    epilogues (``kernels.gru_pallas``) so z, r, r·h and tanh(q) never
+    round-trip HBM between conv fusions inside the 12-iteration scan.
+    """
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, x, hw):
+        from raft_tpu.kernels.gru_pallas import gru_cell_lane_major
+
+        dirs = (
+            # (kernel, padding, z-name, r-name, q-name)
+            ((1, 5), (0, 2), "convz1", "convr1", "convq1"),  # horizontal
+            ((5, 1), (2, 0), "convz2", "convr2", "convq2"),  # vertical
+        )
+        for k, pad, zn, rn, qn in dirs:
+            hx = jnp.concatenate([h, x], axis=-1)
+            zl, rl = conv_pair_lane_major(
+                TorchConv(self.hidden_dim, k, (1, 1), pad, self.dtype,
+                          name=zn),
+                TorchConv(self.hidden_dim, k, (1, 1), pad, self.dtype,
+                          name=rn), hx, hw)
+            convq = TorchConv(self.hidden_dim, k, (1, 1), pad, self.dtype,
+                              name=qn)
+            h = gru_cell_lane_major(
+                h, zl, rl,
+                lambda rh, convq=convq: conv_lane_major(
+                    convq, jnp.concatenate([rh, x], axis=-1), hw))
+        return h
+
+
+class FusedBasicMotionEncoder(nn.Module):
+    """Lane-major :class:`BasicMotionEncoder`: identical parameters and
+    channel arithmetic (126+2), convs as shifted tap contractions. The
+    7x7-on-flow conv has cin=2, so its taps stay broadcast FMAs
+    (``layers._FMA_MAX_CIN``) rather than padding a 2-deep contraction
+    onto the MXU."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, flow, corr, hw):
+        cor = nn.relu(conv_lane_major(
+            TorchConv(256, (1, 1), (1, 1), (0, 0), self.dtype,
+                      name="convc1"), corr, hw))
+        cor = nn.relu(conv_lane_major(
+            TorchConv(192, (3, 3), (1, 1), (1, 1), self.dtype,
+                      name="convc2"), cor, hw))
+        flo = nn.relu(conv_lane_major(
+            TorchConv(128, (7, 7), (1, 1), (3, 3), self.dtype,
+                      name="convf1"), flow, hw))
+        flo = nn.relu(conv_lane_major(
+            TorchConv(64, (3, 3), (1, 1), (1, 1), self.dtype,
+                      name="convf2"), flo, hw))
+        out = nn.relu(conv_lane_major(
+            TorchConv(126, (3, 3), (1, 1), (1, 1), self.dtype,
+                      name="conv"), jnp.concatenate([cor, flo], -1), hw))
+        return jnp.concatenate([out, flow.astype(out.dtype)], axis=-1)
+
+
+class FusedBasicUpdateBlock(nn.Module):
+    """``gru_impl='fused'`` drop-in for :class:`BasicUpdateBlock`: same
+    parameter tree (checkpoints interchangeable, oracle-pinned in
+    tests/test_gru_fused.py), NHWC at the interface, lane-major inside.
+
+    The motion encoder and GRU — the scan body's latency-bound band —
+    run flattened; the flow head and mask head stay NHWC: they run once
+    per iteration on 128→256-channel 3x3 convs that are already
+    MXU-shaped, and the batched convex upsampler consumes their NHWC
+    outputs directly after the scan.
+    """
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, net, inp, corr, flow):
+        B, H, W, _ = net.shape
+        hw = (H, W)
+
+        def flat(a):
+            return a.reshape(B, H * W, a.shape[-1])
+
+        motion = FusedBasicMotionEncoder(self.dtype, name="encoder")(
+            flat(flow), flat(corr), hw)
+        gru_in = jnp.concatenate([flat(inp), motion], axis=-1)
+        net_f = FusedSepConvGRU(self.hidden_dim, self.dtype, name="gru")(
+            flat(net), gru_in, hw)
+        net = net_f.reshape(B, H, W, self.hidden_dim)
+        delta = FlowHead(256, self.dtype, name="flow_head")(net)
+
+        # .25 scale to balance gradients (update.py:134-135)
+        mask = TorchConv(256, (3, 3), (1, 1), (1, 1), self.dtype,
+                         name="mask_conv1")(net)
+        mask = nn.relu(mask)
+        mask = TorchConv(64 * 9, (1, 1), (1, 1), (0, 0), self.dtype,
+                         name="mask_conv2")(mask)
+        return net, 0.25 * mask, delta
 
 
 class SmallUpdateBlock(nn.Module):

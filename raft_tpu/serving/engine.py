@@ -50,12 +50,16 @@ class RAFTEngine:
         (SURVEY.md §5 long-context). The TRT analog has nothing like
         this; DataParallel never served (train.py:138 is training-only).
 
-        ``exact_shapes``: never route to a larger bucket — compile (and
-        cache) one executable per exact ÷8-padded request shape instead.
-        Costs a compile per distinct shape but removes the bucket-fill
-        accuracy artifact entirely (the fill shifts instance-norm
-        statistics; see infer_batch) — the TRT-dynamic-shapes parity
-        setting for accuracy-sensitive serving.
+        ``exact_shapes``: never route to a SPATIALLY larger bucket —
+        compile (and cache) one executable per exact ÷8-padded request
+        spatial shape instead. Costs a compile per distinct shape but
+        removes the bucket-fill accuracy artifact entirely (the spatial
+        fill shifts instance-norm statistics; see infer_batch) — the
+        TRT-dynamic-shapes parity setting for accuracy-sensitive
+        serving. Batch is still allowed to fill up to an
+        already-compiled same-spatial bucket: batch fill is per-sample
+        neutral, and without it every ragged sliding-window tail
+        (``infer``'s last chunk) would compile its own executable.
         """
         self.config = config
         self.iters = iters
@@ -168,6 +172,22 @@ class RAFTEngine:
 
     def _select_bucket(self, b: int, h: int, w: int
                        ) -> Optional[Tuple[int, int, int]]:
+        if self.exact_shapes:
+            # exact-shapes mode is exact SPATIALLY — spatial fill is
+            # what shifts the encoders' instance-norm statistics (the
+            # accuracy artifact the mode exists to remove). Batch fill
+            # is per-sample neutral (instance norm reduces over H, W
+            # only; eval-mode BatchNorm uses running averages — the
+            # fill changes values only at conv-vectorization fp32 noise
+            # scale, measured ~3e-5 px), so a
+            # ragged sliding-window tail routes to an already-compiled
+            # same-spatial bucket with fill + crop instead of compiling
+            # one executable per distinct tail batch (pinned in
+            # tests/test_serving.py: len(_compiled) stays 1 across a
+            # ragged sequence).
+            fits = [s for s in self._compiled
+                    if s[0] >= b and s[1] == h and s[2] == w]
+            return min(fits, key=lambda s: s[0]) if fits else None
         fits = [s for s in self._compiled
                 if s[0] >= b and s[1] >= h and s[2] >= w]
         if not fits:
@@ -194,7 +214,7 @@ class RAFTEngine:
         left, right, top, bottom = pad_amounts(h, w)
         hp, wp = h + top + bottom, w + left + right
 
-        bucket = None if self.exact_shapes else self._select_bucket(b, hp, wp)
+        bucket = self._select_bucket(b, hp, wp)
         if bucket is None:
             bb, bh = b, hp
             if self.mesh is not None:
@@ -225,7 +245,13 @@ class RAFTEngine:
     def infer(self, images: Sequence[np.ndarray], batch_size: int = 4,
               time_it: bool = False) -> List[np.ndarray]:
         """Sliding-window flow over a frame sequence (raft_trt.py:41-67):
-        consecutive pairs, chunked into batches."""
+        consecutive pairs, chunked into batches.
+
+        The last chunk is usually ragged (n % batch_size pairs); bucket
+        routing batch-fills it into the executable the full chunks
+        already compiled — one executable serves the whole sequence in
+        both bucketed and exact-shapes engines (pinned in
+        tests/test_serving.py)."""
         flows: List[np.ndarray] = []
         n = len(images) - 1
         t0 = time.perf_counter()

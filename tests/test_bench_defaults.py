@@ -262,6 +262,13 @@ class TestBenchDefaultFlags:
                          "--corr_impl", "softsel", "--fused_loss",
                          "--scan_unroll", "2"]
 
+    def test_gru_impl_mapped(self, tmp_path):
+        # a fused-GRU ladder winner must trace/profile as the fused step
+        flags = self._flags(tmp_path, {
+            "batches": [8], "gru_impl": "fused",
+        }, with_batch=False)
+        assert flags == ["--gru_impl", "fused"]
+
     def test_remat_defaults_mapped(self, tmp_path):
         # a remat ladder winner must trace as the remat step, not the
         # plain one (profile_step grew --remat_policy for this)
@@ -302,6 +309,54 @@ class TestScanUnrollPlumbing:
         # "applied" log line
         bench, _ = modules
         assert not bench._DEFAULTS_SCHEMA["scan_unroll"](True)
+
+
+class TestGruImplPlumbing:
+    """gru_impl A/B rungs (round 6): the metric tag, defaults schema and
+    runbook flag mapping must round-trip so a measured fused-GRU win can
+    set the bare-bench default through pick_bench_defaults."""
+
+    def test_metric_tag_roundtrip(self, modules):
+        _, pick = modules
+        f = pick.flags_from_metric
+        assert f("raft_basic_train_chairs_368x496_bf16_b8_iters12_1chip"
+                 "_grufused") == {"batches": [8], "gru_impl": "fused"}
+        # composed with the full r5-winner tag set; the gru suffix must
+        # not break the trailing corr_impl match
+        assert f("raft_basic_train_chairs_368x496_bf16_b8_iters12_1chip"
+                 "_softsel_corrbfloat16_fusedloss_grufused") == {
+            "batches": [8], "corr_impl": "softsel",
+            "corr_dtype": "bfloat16", "fused_loss": True,
+            "gru_impl": "fused"}
+        assert f("raft_basic_train_chairs_368x496_bf16_b8_iters12_1chip"
+                 "_softsel_corrbfloat16_unroll2_gruxla") == {
+            "batches": [8], "corr_impl": "softsel",
+            "corr_dtype": "bfloat16", "scan_unroll": 2, "gru_impl": "xla"}
+
+    def test_defaults_schema_accepts_impls_only(self, modules):
+        bench, _ = modules
+        assert bench._DEFAULTS_SCHEMA["gru_impl"]("fused")
+        assert bench._DEFAULTS_SCHEMA["gru_impl"]("xla")
+        assert not bench._DEFAULTS_SCHEMA["gru_impl"]("mosaic")
+        assert not bench._DEFAULTS_SCHEMA["gru_impl"](True)
+
+    def test_defaults_applied_to_args(self, modules, tmp_path, monkeypatch):
+        bench, _ = modules
+        (tmp_path / "BENCH_DEFAULTS.json").write_text(json.dumps(
+            {"batches": [8], "gru_impl": "fused"}))
+        monkeypatch.setattr(bench.os.path, "dirname",
+                            lambda _: str(tmp_path))
+        args = bench._build_parser().parse_args([])
+        passed = vars(bench._build_parser(suppress=True)
+                      .parse_args([])).keys()
+        bench._apply_measured_defaults(args, passed)
+        assert args.gru_impl == "fused"
+        # explicit flag still wins
+        args2 = bench._build_parser().parse_args(["--gru-impl", "xla"])
+        passed2 = vars(bench._build_parser(suppress=True)
+                       .parse_args(["--gru-impl", "xla"])).keys()
+        bench._apply_measured_defaults(args2, passed2)
+        assert args2.gru_impl == "xla"
 
 
 class TestHangWatch:

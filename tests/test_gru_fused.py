@@ -1,0 +1,237 @@
+"""Parity for the fused (lane-major + Pallas epilogue) update block.
+
+The oracle is the reference-shaped NHWC path (``gru_impl='xla'``), itself
+pinned against torch (test_reference_parity). Per-iteration parity is the
+meaningful pin — the refinement recurrence amplifies ANY fp32
+accumulation-order noise at random-init weights (see
+test_model.test_corr_dtype_bf16_model_drift), so the model-level check is
+deliberately loose while the single-application fwd/grad checks are
+tight. Kernels run in interpret mode on CPU, following
+tests/test_corr_alt_pallas.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.kernels import gru_pallas
+from raft_tpu.models.update import BasicUpdateBlock, FusedBasicUpdateBlock
+
+
+@pytest.fixture(autouse=True)
+def interpret_mode(monkeypatch):
+    monkeypatch.setattr(gru_pallas, "_INTERPRET", True)
+
+
+@pytest.fixture(scope="module")
+def block_setup():
+    rng = np.random.RandomState(3)
+    B, H, W = 2, 6, 8
+
+    def arr(c, scale=0.1):
+        return jnp.asarray(rng.randn(B, H, W, c).astype(np.float32) * scale)
+
+    net, inp, corr = arr(128), arr(128), arr(324)
+    flow = arr(2, scale=1.0)
+    variables = BasicUpdateBlock(128).init(
+        jax.random.PRNGKey(7), net, inp, corr, flow)
+    return variables, (net, inp, corr, flow)
+
+
+class TestGruPallasKernels:
+    """Kernel-level oracle: the fused epilogues vs their jnp formulas,
+    forward and VJP, at a tile-exact and a pad-requiring shape."""
+
+    # single-tile, exact-divisor-tiled, and padded (near-prime N) regimes
+    shapes = [(2, 37, 16), (1, 600, 128), (1, 1021, 8)]
+
+    def _data(self, shape, n):
+        rng = np.random.RandomState(sum(shape))
+        return [jnp.asarray(rng.randn(*shape).astype(np.float32))
+                for _ in range(n)]
+
+    @pytest.mark.parametrize("shape", shapes)
+    def test_gates_fwd_and_vjp(self, shape):
+        zl, rl, h = self._data(shape, 3)
+        z, rh = gru_pallas.gru_gates(zl, rl, h)
+        np.testing.assert_allclose(np.asarray(z),
+                                   np.asarray(jax.nn.sigmoid(zl)),
+                                   atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(rh), np.asarray(jax.nn.sigmoid(rl) * h),
+            atol=1e-6, rtol=1e-6)
+
+        def loss(fn):
+            def f(args):
+                a, b = fn(*args)
+                return jnp.sum(a ** 2) + jnp.sum(jnp.abs(b))
+            return f
+
+        oracle = loss(lambda zl, rl, h: (jax.nn.sigmoid(zl),
+                                         jax.nn.sigmoid(rl) * h))
+        g_want = jax.grad(oracle)((zl, rl, h))
+        g_got = jax.grad(loss(gru_pallas.gru_gates))((zl, rl, h))
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
+
+    @pytest.mark.parametrize("shape", shapes)
+    def test_blend_fwd_and_vjp(self, shape):
+        z, h, ql = self._data(shape, 3)
+        z = jax.nn.sigmoid(z)  # blend's z input is a sigmoid output
+        out = gru_pallas.gru_blend(z, h, ql)
+        want = (1.0 - z) * h + z * jnp.tanh(ql)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=1e-6, rtol=1e-6)
+
+        def loss(fn):
+            return lambda args: jnp.sum(fn(*args) ** 2)
+
+        oracle = loss(lambda z, h, ql: (1.0 - z) * h + z * jnp.tanh(ql))
+        g_want = jax.grad(oracle)((z, h, ql))
+        g_got = jax.grad(loss(gru_pallas.gru_blend))((z, h, ql))
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
+
+
+class TestRowTile:
+    def test_production_geometry_needs_no_pad(self):
+        """46x62 -> N=2852: the tile must divide it exactly — padding
+        every operand with a copy on the hot path is what the kernels
+        exist to avoid."""
+        rows, pad = gru_pallas._row_tile(2852)
+        assert pad == 0 and 2852 % rows == 0
+        assert gru_pallas._MIN_ROWS <= rows <= gru_pallas._ROWS
+
+    def test_small_and_prime_cases(self):
+        assert gru_pallas._row_tile(37) == (37, 0)      # single tile
+        assert gru_pallas._row_tile(512) == (512, 0)
+        rows, pad = gru_pallas._row_tile(1021)          # prime -> pad
+        assert rows == gru_pallas._ROWS and (1021 + pad) % rows == 0
+
+
+class TestConvLaneMajor:
+    """The shifted-tap contraction vs the NHWC conv it restructures, over
+    every kernel geometry the fused block uses (incl. the tiny-cin FMA
+    path of the 7x7-on-flow conv and the 1x1 pure-GEMM shortcut)."""
+
+    @pytest.mark.parametrize("k,pad,cin,cout", [
+        ((1, 5), (0, 2), 24, 16),   # SepConvGRU horizontal
+        ((5, 1), (2, 0), 24, 16),   # SepConvGRU vertical
+        ((3, 3), (1, 1), 24, 16),   # motion-encoder 3x3
+        ((7, 7), (3, 3), 2, 16),    # flow conv: cin=2 -> broadcast FMAs
+        ((1, 1), (0, 0), 24, 16),   # pointwise -> single GEMM
+    ])
+    def test_matches_nhwc_conv(self, k, pad, cin, cout):
+        import flax.linen as nn
+
+        from raft_tpu.models.layers import TorchConv, conv_lane_major
+
+        B, H, W = 2, 5, 7
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(B, H, W, cin).astype(np.float32))
+
+        class Nhwc(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return TorchConv(cout, k, (1, 1), pad, name="c")(x)
+
+        class Lane(nn.Module):
+            @nn.compact
+            def __call__(self, xf):
+                return conv_lane_major(
+                    TorchConv(cout, k, (1, 1), pad, name="c"), xf, (H, W))
+
+        v = Nhwc().init(jax.random.PRNGKey(0), x)
+        want = np.asarray(Nhwc().apply(v, x)).reshape(B, H * W, cout)
+        got = np.asarray(Lane().apply(v, x.reshape(B, H * W, cin)))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+class TestFusedUpdateBlock:
+    def test_param_tree_identical(self, block_setup):
+        """gru_impl swaps the implementation, never the parameters:
+        identical tree structure AND init draws -> checkpoints are
+        interchangeable between the two paths."""
+        variables, (net, inp, corr, flow) = block_setup
+        v_f = FusedBasicUpdateBlock(128).init(
+            jax.random.PRNGKey(7), net, inp, corr, flow)
+        assert (jax.tree_util.tree_structure(variables)
+                == jax.tree_util.tree_structure(v_f))
+        for a, b in zip(jax.tree.leaves(variables), jax.tree.leaves(v_f)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_per_iteration_fwd_matches_xla(self, block_setup):
+        variables, args = block_setup
+        want = BasicUpdateBlock(128).apply(variables, *args)
+        got = FusedBasicUpdateBlock(128).apply(variables, *args)
+        for name, a, b in zip(("net", "mask", "delta"), got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=1e-5,
+                                       err_msg=name)
+
+    def test_per_iteration_grad_matches_xla(self, block_setup):
+        variables, (net, inp, corr, flow) = block_setup
+
+        def loss(block):
+            def f(params):
+                n, m, d = block.apply({"params": params}, net, inp, corr,
+                                      flow)
+                return (jnp.sum(n ** 2) + 1e-3 * jnp.sum(m ** 2)
+                        + jnp.sum(d ** 2))
+            return f
+
+        g_want = jax.grad(loss(BasicUpdateBlock(128)))(variables["params"])
+        g_got = jax.grad(loss(FusedBasicUpdateBlock(128)))(
+            variables["params"])
+        flat_w = jax.tree_util.tree_flatten_with_path(g_want)[0]
+        flat_g = jax.tree_util.tree_flatten_with_path(g_got)[0]
+        for (pa, a), (pb, b) in zip(flat_g, flat_w):
+            assert pa == pb
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4,
+                err_msg=jax.tree_util.keystr(pa))
+
+
+class TestFusedModel:
+    def test_model_fused_matches_xla(self):
+        """End-to-end on CPU via interpret mode (the acceptance run).
+        Loose tolerance by design: the recurrence amplifies fp32
+        accumulation-order noise at random init (measured 5.5e-4 px at
+        iters=3 on this geometry; a real semantic mismatch is orders
+        beyond that)."""
+        from raft_tpu.models import RAFT
+
+        rng = np.random.RandomState(0)
+        img1 = jnp.asarray(rng.rand(1, 32, 32, 3).astype(np.float32) * 255)
+        img2 = jnp.asarray(rng.rand(1, 32, 32, 3).astype(np.float32) * 255)
+
+        m_xla = RAFT(RAFTConfig(small=False))
+        m_fused = RAFT(RAFTConfig(small=False, gru_impl="fused"))
+        variables = m_xla.init(jax.random.PRNGKey(0), img1, img2, iters=1)
+        want = np.asarray(m_xla.apply(variables, img1, img2, iters=3))
+        got = np.asarray(m_fused.apply(variables, img1, img2, iters=3))
+        np.testing.assert_allclose(got, want, atol=5e-3, rtol=1e-4)
+
+    def test_mixed_precision_fused_runs(self):
+        """bf16 compute dtype flows through the lane-major convs and the
+        Pallas epilogues (weak-typed constants must not upcast)."""
+        from raft_tpu.models import RAFT
+
+        model = RAFT(RAFTConfig(small=False, gru_impl="fused",
+                                mixed_precision=True))
+        img = jnp.ones((1, 32, 32, 3)) * 100
+        variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+        out = model.apply(variables, img, img, iters=2)
+        assert out.dtype == jnp.float32  # upsample is an fp32 island
+        assert bool(jnp.isfinite(out).all())
+
+    def test_small_model_rejects_fused(self):
+        with pytest.raises(ValueError, match="no fused path"):
+            RAFTConfig(small=True, gru_impl="fused")
+        with pytest.raises(ValueError, match="gru_impl"):
+            RAFTConfig(gru_impl="mosaic")

@@ -130,6 +130,36 @@ class TestEngine:
         assert len(flows) == 3
         assert flows[0].shape == (64, 64, 2)
 
+    def test_ragged_tail_reuses_compiled_bucket(self, small_setup, rng):
+        """A 5-pair sequence at batch_size=2 ends in a 1-pair tail. The
+        tail must batch-fill into the executable the full chunks already
+        compiled — ONE executable serves the whole sequence — in both
+        the bucketed and the exact-shapes engine (the latter used to
+        compile a second executable per distinct tail batch)."""
+        cfg, variables = small_setup
+        frames = [rng.rand(32, 32, 3).astype(np.float32) * 255
+                  for _ in range(6)]
+
+        eng = RAFTEngine(variables, cfg, iters=1, envelope=[])
+        flows = eng.infer(frames, batch_size=2)
+        assert len(flows) == 5
+        assert len(eng._compiled) == 1, sorted(eng._compiled)
+
+        eng2 = RAFTEngine(variables, cfg, iters=1, envelope=[],
+                          exact_shapes=True)
+        flows2 = eng2.infer(frames, batch_size=2)
+        assert len(flows2) == 5
+        assert sorted(eng2._compiled) == [(2, 32, 32)]
+
+        # batch fill is per-sample neutral: the batch-filled tail matches
+        # the tail pair computed alone to fp32 vectorization noise
+        # (measured ~3e-5 px; spatial fill — the real accuracy artifact
+        # — is still exact in this mode)
+        alone = RAFTEngine(variables, cfg, iters=1, envelope=[],
+                           exact_shapes=True).infer_batch(
+            frames[-2][None], frames[-1][None])[0]
+        np.testing.assert_allclose(flows2[-1], alone, atol=1e-3, rtol=1e-4)
+
 
 class TestMeshServing:
     def test_sharded_engine_matches_single_device(self, small_setup, rng):

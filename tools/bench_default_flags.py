@@ -31,6 +31,8 @@ def flags(with_batch: bool) -> list:
         out.append("--fused_loss")
     if d.get("scan_unroll", 1) != 1:
         out += ["--scan_unroll", str(d["scan_unroll"])]
+    if d.get("gru_impl"):
+        out += ["--gru_impl", d["gru_impl"]]
     if d.get("remat"):
         out.append("--remat")
         if d.get("remat_policy"):

@@ -1,0 +1,154 @@
+#!/bin/bash
+# Round-6 on-chip runbook: the gru_impl whole-step A/B.
+#
+# PR 2 built a second update-block implementation (RAFTConfig.gru_impl
+# = 'fused': lane-major scan-body convs + Pallas gate/blend epilogues,
+# see PROFILE.md round 6). Promotion is decided HERE, by whole-step
+# rungs at the proven r5 defaults — never by isolated kernel benches
+# (they steered the repo wrong for two rounds; PROFILE round 5).
+#
+# Rung design: the pair differs ONLY in the gru_impl knob, both pinned
+# to the current BENCH_DEFAULTS winner config (softsel + bf16 volumes +
+# fused loss, b8 first). The explicit _gruxla control re-measures the
+# incumbent in the SAME window so the A/B is same-day, same-tunnel —
+# cross-window comparisons have been off by more than the effects we
+# chase. bench.py itself provides OOM laddering and the one-shot
+# crash-retry re-exec (RAFT_BENCH_* env), so a worker death resumes at
+# the crashed rung instead of zeroing the pair.
+#
+# Marker-resumable across windows like round 5; ladder rows feed
+# tools/pick_bench_defaults.py, and a re-picked BENCH_DEFAULTS.json is
+# only committed after a bare-run reproduction.
+set -u
+cd /root/repo
+OUT=${1:-/tmp/onchip_round6.out}
+MARK=${RAFT_R6_MARK:-/root/.cache/raft_tpu/r6_markers}
+LADDER=${RAFT_R6_LADDER:-/root/.cache/raft_tpu/r6_ladder}
+mkdir -p "$MARK" "$LADDER"
+# seed with the r5 rows so a slow r6 set can't downgrade the pick below
+# what is already proven
+cp -n /root/.cache/raft_tpu/r5_ladder/*.json "$LADDER"/ 2>/dev/null || true
+log() { echo "=== $(date -u +%H:%M:%S) $* ===" >> "$OUT"; }
+snap() { cp "$OUT" /root/repo/ONCHIP_r06.log 2>/dev/null || true; }
+wait_chip() {
+    for _ in 1 2 3 4 5; do
+        if timeout -k 10 120 python -c \
+            "import jax; assert jax.devices()[0].platform != 'cpu'" \
+            >/dev/null 2>&1; then return 0; fi
+        log "chip not answering; waiting 60s"
+        sleep 60
+    done
+    return 1
+}
+step() {
+    local name=$1 tmo=$2; shift 2
+    if [ -e "$MARK/$name" ]; then log "skip $name (done)"; return 0; fi
+    wait_chip || { log "SKIP $name (chip unavailable)"; return 1; }
+    log "begin $name"
+    if timeout "$tmo" "$@" >> "$OUT" 2>&1; then
+        touch "$MARK/$name"; log "done $name"
+    else
+        local rc=$?
+        log "retry $name after 90s (rc=$rc)"
+        sleep 90
+        if timeout "$tmo" "$@" >> "$OUT" 2>&1; then
+            touch "$MARK/$name"; log "done $name (retry)"
+        else
+            log "FAILED rc=$? $name"
+        fi
+    fi
+    snap
+}
+bench_cfg() {
+    local tag=$1 tmo=$2; shift 2
+    if [ -e "$MARK/bench_$tag" ]; then log "skip bench_$tag"; return 0; fi
+    wait_chip || { log "SKIP bench_$tag (chip unavailable)"; return 1; }
+    log "begin bench_$tag: $*"
+    if timeout "$tmo" python bench.py --steps 10 "$@" \
+            > "$LADDER/$tag.json" 2>> "$OUT"; then
+        cat "$LADDER/$tag.json" >> "$OUT"
+        touch "$MARK/bench_$tag"; log "done bench_$tag"
+    else
+        log "FAILED bench_$tag rc=$?"; cat "$LADDER/$tag.json" >> "$OUT"
+    fi
+    snap
+}
+commit_msmt() {  # measurement artifacts only — no source changes
+    local msg=$1; shift
+    for f in "$@"; do git add "$f" 2>/dev/null || true; done
+    git diff --cached --quiet || git commit -q -m "$msg" -m \
+        "No-Verification-Needed: measurement logs and records only"
+}
+
+# ---- the A/B pair: identical config, only gru_impl differs ------------
+R5_WINNER="--corr-dtype bfloat16 --no-remat --fused-loss --corr-impl softsel"
+# shellcheck disable=SC2086
+bench_cfg g_gruxla 2400 --batches 8 6 $R5_WINNER --gru-impl xla
+# fused first compile is new HLO territory: generous cap, same rungs
+# shellcheck disable=SC2086
+bench_cfg g_grufused 2700 --batches 8 6 $R5_WINNER --gru-impl fused
+commit_msmt "r6 gru_impl A/B ladder rows" ONCHIP_r06.log
+
+# ---- secondary: fused at the b10 memory edge (the Pallas epilogues
+# drop gate intermediates from the scan's saved-residual stack, so the
+# fused path may fit a batch the xla path OOMs at) -----------------------
+# shellcheck disable=SC2086
+bench_cfg g_grufused_b10 2700 --batches 10 $R5_WINNER --gru-impl fused
+
+# ---- defaults decision (same discipline as r5: a re-picked
+# BENCH_DEFAULTS.json is only committed after a bare reproduction) ------
+step pick_defaults_r6 120 python tools/pick_bench_defaults.py "$LADDER"
+if [ -e "$MARK/pick_defaults_r6" ] && [ ! -e "$MARK/defaults_decided" ] \
+        && [ ! -e "$MARK/defaults_changed" ]; then
+    if git diff --quiet BENCH_DEFAULTS.json; then
+        touch "$MARK/defaults_decided"  # pick kept the proven defaults
+    else
+        touch "$MARK/defaults_changed"
+        log "defaults re-picked - bare reproduction owed"
+    fi
+fi
+if [ -e "$MARK/defaults_changed" ] && [ ! -e "$MARK/bare_bench_final" ]; then
+    if wait_chip; then
+        log "reproducing re-picked defaults with a bare run"
+        if timeout 2700 python bench.py \
+                > "$LADDER/bare_final.json" 2>> "$OUT"; then
+            cat "$LADDER/bare_final.json" >> "$OUT"
+            if python - "$LADDER/bare_final.json" <<'EOF'
+import json, sys
+row = json.load(open(sys.argv[1]))
+sys.exit(0 if row.get("value", 0) > 0 else 1)
+EOF
+            then
+                touch "$MARK/bare_bench_final" "$MARK/defaults_decided"
+                cp "$LADDER/bare_final.json" /root/repo/BENCH_r06_local.json
+                snap
+                commit_msmt \
+                    "Bare bench reproduction at the re-picked defaults" \
+                    BENCH_r06_local.json BENCH_DEFAULTS.json ONCHIP_r06.log
+            fi
+        else
+            log "FAILED bare_bench_final rc=$?"
+        fi
+        snap
+    fi
+fi
+if [ -e "$MARK/defaults_decided" ]; then
+    commit_msmt "r6 ladder rows + defaults" ONCHIP_r06.log \
+        BENCH_DEFAULTS.json
+else
+    commit_msmt "r6 ladder rows" ONCHIP_r06.log
+fi
+
+# ---- trace the loser's question: where did the fused step's time go ---
+# (only worth a window slot once both A/B rungs have numbers)
+if [ -e "$MARK/bench_g_gruxla" ] && [ -e "$MARK/bench_g_grufused" ]; then
+    step trace_grufused 2400 python -m raft_tpu.cli.profile_step \
+        --batch 8 --corr_impl softsel --corr_dtype bfloat16 --fused-loss \
+        --gru_impl fused --steps 10 --trace-dir /tmp/raft_trace_r6
+    step trace_summary_r6 1200 python -m raft_tpu.cli.trace_summary \
+        /tmp/raft_trace_r6
+fi
+
+log "round6 runbook complete"
+snap
+commit_msmt "On-chip round-6 artifacts: gru_impl A/B ladder" ONCHIP_r06.log

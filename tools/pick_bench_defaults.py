@@ -34,8 +34,11 @@ def flags_from_metric(metric: str):
     mu = re.search(r"_unroll(\d+)", metric)
     if mu:
         flags["scan_unroll"] = int(mu.group(1))
+    mg = re.search(r"_gru(xla|fused)", metric)
+    if mg:
+        flags["gru_impl"] = mg.group(1)
     mi = re.search(r"_(gather|onehot_t|onehot|softsel|pallas)$", re.sub(
-        r"_unroll\d+", "", metric.replace(
+        r"_(?:unroll\d+|gruxla|grufused)", "", metric.replace(
             "_corrbfloat16", "").replace("_corrfloat32", "").replace(
             "_fusedloss", "")))
     if mi:
