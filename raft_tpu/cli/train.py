@@ -9,13 +9,20 @@ presets fill defaults so single-stage invocations match the shell recipes.
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 
 from raft_tpu.cli._args import add_corr_args, corr_overrides
 from raft_tpu.config import RAFTConfig, TrainConfig, stage_config
 
 
 def build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(description="Train RAFT on TPU")
+    # no abbreviations: _supervise strips --supervise/--max_restarts
+    # from the child argv by exact name, and an accepted abbreviation
+    # (--superv) surviving the strip would re-enter the supervisor in
+    # every child — an unbounded process recursion that never trains
+    p = argparse.ArgumentParser(description="Train RAFT on TPU",
+                                allow_abbrev=False)
     p.add_argument("--name", default="raft", help="name your experiment")
     p.add_argument("--stage", default="chairs",
                    choices=["chairs", "things", "sintel", "kitti"])
@@ -53,6 +60,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_workers", type=int, default=4)
     p.add_argument("--val_freq", type=int, default=None,
                    help="checkpoint + validation cadence in steps")
+    p.add_argument("--hang_s", type=float, default=None,
+                   help="no-progress watchdog deadline in seconds (exit "
+                        "3 on a wedged backend); size it ABOVE first-"
+                        "step compile + one sum_freq window + one "
+                        "validation pass — see TrainConfig.hang_s")
+    p.add_argument("--on_bad_sample", choices=("raise", "skip"), default=None,
+                   help="loader policy for a failing decode/augment: "
+                        "'skip' resamples with a counted warning instead "
+                        "of killing the run (a rotten file is a "
+                        "deterministic crash no restart can clear) — "
+                        "see TrainConfig.on_bad_sample")
+    p.add_argument("--stall_s", type=float, default=None,
+                   help="loader batch deadline in seconds: a hung decode "
+                        "raises LoaderStallError instead of wedging the "
+                        "loop (0 disables) — see TrainConfig.stall_s")
+    p.add_argument("--supervise", action="store_true",
+                   help="run training as a supervised child process: "
+                        "auto-relaunch with --resume after a wedge "
+                        "(exit 3), preemption signal, or crash; gives "
+                        "up on deterministic failures (two deaths at "
+                        "the same restored step) or after "
+                        "--max_restarts")
+    p.add_argument("--max_restarts", type=int, default=5,
+                   help="restart budget under --supervise")
     p.add_argument("--synthetic", type=int, default=None, metavar="N",
                    help="train on N generated chairs-shaped samples instead "
                         "of a real dataset — the full decode→augment→collate "
@@ -79,7 +110,7 @@ def configs_from_args(args) -> tuple[RAFTConfig, TrainConfig]:
     if args.fused_loss is not None:  # tri-state: None = config auto (fused where available)
         overrides["fused_loss"] = args.fused_loss
     for k in ("lr", "num_steps", "batch_size", "wdecay", "gamma",
-              "val_freq"):
+              "val_freq", "hang_s", "on_bad_sample", "stall_s"):
         v = getattr(args, k)
         if v is not None:
             overrides[k] = v
@@ -97,6 +128,8 @@ def main(argv=None):
 
     setup_cli()
     args = build_parser().parse_args(argv)
+    if args.supervise:
+        sys.exit(_supervise(args, argv))
     from raft_tpu.training.trainer import train
 
     model_cfg, train_cfg = configs_from_args(args)
@@ -104,6 +137,40 @@ def main(argv=None):
     if args.synthetic:
         loader = _synthetic_loader(args.synthetic, train_cfg)
     train(model_cfg, train_cfg, resume=args.resume, loader=loader)
+
+
+def _strip_flag(argv, flag, nargs):
+    out, i = [], 0
+    while i < len(argv):
+        a = argv[i]
+        if a == flag:
+            i += 1 + nargs
+            continue
+        if nargs and a.startswith(flag + "="):
+            i += 1
+            continue
+        out.append(a)
+        i += 1
+    return out
+
+
+def _supervise(args, argv) -> int:
+    """Relaunch this CLI as a supervised child with ``--resume`` forced
+    — the restart path must restore, not retrain (the half of wedge
+    recovery the watchdog's exit 3 was waiting for)."""
+    from raft_tpu.training.supervisor import Supervisor
+
+    _, train_cfg = configs_from_args(args)
+    stage_dir = os.path.join(train_cfg.checkpoint_dir, train_cfg.name,
+                             train_cfg.stage)
+    child = list(sys.argv[1:]) if argv is None else list(argv)
+    child = _strip_flag(child, "--supervise", nargs=0)
+    child = _strip_flag(child, "--max_restarts", nargs=1)
+    if "--resume" not in child:
+        child.append("--resume")
+    sup = Supervisor([sys.executable, "-m", "raft_tpu.cli.train", *child],
+                     max_restarts=args.max_restarts, ckpt_dir=stage_dir)
+    return sup.run()
 
 
 def _synthetic_loader(n: int, train_cfg):
@@ -130,7 +197,9 @@ def _synthetic_loader(n: int, train_cfg):
     ds = build_dataset(root, crop=train_cfg.image_size)
     return PrefetchLoader(ds, train_cfg.batch_size,
                           num_workers=train_cfg.num_workers,
-                          seed=train_cfg.seed, wire_dtype="uint8")
+                          seed=train_cfg.seed, wire_dtype="uint8",
+                          on_bad_sample=train_cfg.on_bad_sample,
+                          stall_s=train_cfg.stall_s)
 
 
 if __name__ == "__main__":

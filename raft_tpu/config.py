@@ -268,6 +268,17 @@ class TrainConfig:
     # a full validation pass, and the final async-checkpoint flush each
     # count as one gap.
     hang_s: float = 0.0
+    # loader resilience (data/loader.PrefetchLoader): "skip" resamples
+    # a rotten file with a counted warning instead of killing the run
+    # (a supervised restart would replay the same index into the same
+    # decode error — a deterministic crash the supervisor rightly gives
+    # up on); "raise" keeps the strict legacy behavior.
+    on_bad_sample: str = "raise"
+    # deadline in seconds for the consumer's wait on each batch: a hung
+    # decode surfaces as data/loader.LoaderStallError instead of an
+    # eternal hang (0 disables). Unlike hang_s this is recoverable
+    # in-process — size it above the slowest legitimate batch.
+    stall_s: float = 0.0
 
 
 # Stage presets mirroring train_standard.sh:3-6 (2-GPU fp32 recipe).

@@ -20,6 +20,21 @@ from typing import Dict, Iterator, Optional
 
 import numpy as np
 
+from raft_tpu.testing import faults
+
+#: on_bad_sample="skip" gives up after this many consecutive failures
+#: for ONE slot — a dataset where every draw fails is systematically
+#: broken, and resampling forever would spin a worker thread
+_MAX_RESAMPLES = 8
+
+
+class LoaderStallError(RuntimeError):
+    """The consumer's stall deadline (``stall_s``) expired waiting for a
+    batch: a worker is stuck inside decode/augment (hung codec, dead
+    NFS mount). Named so callers and runbooks can tell "data pipeline
+    hung" from a wedged accelerator — without the deadline this was an
+    eternal silent hang in ``cond.wait_for``."""
+
 
 def _collate(samples, wire_dtype: str = "float32",
              check: bool = False) -> Dict[str, np.ndarray]:
@@ -68,14 +83,26 @@ class PrefetchLoader:
     def __init__(self, dataset, batch_size: int, shuffle: bool = True,
                  num_workers: int = 4, drop_last: bool = True,
                  seed: int = 1234, prefetch: int = 4, clamp: bool = True,
-                 wire_dtype: str = "float32"):
+                 wire_dtype: str = "float32",
+                 on_bad_sample: str = "raise", stall_s: float = 0.0):
         if wire_dtype not in ("float32", "uint8"):
             raise ValueError(f"wire_dtype={wire_dtype!r}: choose float32 "
                              "or uint8 (see _collate)")
+        if on_bad_sample not in ("raise", "skip"):
+            raise ValueError(f"on_bad_sample={on_bad_sample!r}: choose "
+                             "'raise' (surface decode errors) or 'skip' "
+                             "(resample with a counted warning)")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.wire_dtype = wire_dtype
+        self.on_bad_sample = on_bad_sample
+        # consumer-side deadline per batch; 0 keeps the legacy wait-
+        # forever behavior (the stable contract for callers that own
+        # their own watchdog)
+        self.stall_s = float(stall_s)
+        self.bad_samples = 0  # running skip count across epochs
+        self._bad_lock = threading.Lock()
         # clamp to the host: more worker threads than spare cores only
         # buys GIL/queue contention (measured on the 1-core deployment
         # host: 1 worker 52.2 pairs/s vs 4 workers 44.6, cli/loader_bench;
@@ -105,6 +132,36 @@ class PrefetchLoader:
             return n // self.batch_size
         return -(-n // self.batch_size)
 
+    def _sample(self, index: int, resample: np.random.RandomState):
+        """One dataset fetch under the ``on_bad_sample`` policy:
+        'raise' surfaces decode errors to the consumer verbatim; 'skip'
+        draws a replacement index (counted, warned) so one rotten file
+        doesn't kill a multi-day run."""
+        tries = 0
+        while True:
+            try:
+                faults.fault_point("loader.sample")  # crash-safety drill
+                return self.dataset[index]
+            except Exception as exc:
+                if self.on_bad_sample != "skip":
+                    raise
+                tries += 1
+                if tries >= _MAX_RESAMPLES:
+                    raise RuntimeError(
+                        f"on_bad_sample='skip' gave up after "
+                        f"{_MAX_RESAMPLES} consecutive bad samples "
+                        f"(last: {type(exc).__name__}: {exc}) — the "
+                        "dataset looks systematically broken, not "
+                        "spotty") from exc
+                with self._bad_lock:
+                    self.bad_samples += 1
+                    n = self.bad_samples
+                warnings.warn(
+                    f"PrefetchLoader: skipped bad sample {index} "
+                    f"({type(exc).__name__}: {exc}); resampling "
+                    f"({n} skipped so far)", stacklevel=2)
+                index = int(resample.randint(len(self.dataset)))
+
     def _epoch_indices(self) -> np.ndarray:
         idx = np.arange(len(self.dataset))
         if self.shuffle:
@@ -133,15 +190,19 @@ class PrefetchLoader:
             # per-worker reseed (datasets.py:45-51 analog)
             if hasattr(self.dataset, "reseed"):
                 self.dataset.reseed(self.seed + worker_id * 7919 + self.epoch)
+            resample = np.random.RandomState(
+                self.seed + worker_id * 104729 + self.epoch)
             while not stop.is_set():
                 ahead.acquire()
+                if stop.is_set():
+                    return  # woken by the consumer's shutdown release
                 try:
                     bi, batch_idx = task_q.get_nowait()
                 except queue.Empty:
                     ahead.release()
                     return
                 try:
-                    batch = _collate([self.dataset[int(i)]
+                    batch = _collate([self._sample(int(i), resample)
                                       for i in batch_idx],
                                      self.wire_dtype,
                                      check=(bi == 0))
@@ -151,7 +212,8 @@ class PrefetchLoader:
                     results[bi] = batch
                     cond.notify_all()
 
-        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True,
+                                    name=f"PrefetchLoader-w{w}")
                    for w in range(self.num_workers)]
         for t in threads:
             t.start()
@@ -159,7 +221,13 @@ class PrefetchLoader:
         try:
             for next_bi in range(len(batches)):
                 with cond:
-                    cond.wait_for(lambda: next_bi in results)
+                    if not cond.wait_for(lambda: next_bi in results,
+                                         timeout=self.stall_s or None):
+                        raise LoaderStallError(
+                            f"batch {next_bi} not produced within "
+                            f"stall_s={self.stall_s:.0f}s — a worker is "
+                            "stuck in decode/augment; see "
+                            "PrefetchLoader(stall_s=, on_bad_sample=)")
                     batch = results.pop(next_bi)
                 ahead.release()
                 if isinstance(batch, Exception):
@@ -167,14 +235,22 @@ class PrefetchLoader:
                 yield batch
         finally:
             stop.set()
+            # wake every worker parked in ahead.acquire(): on an early
+            # consumer exit (break, exception, stall) nobody would ever
+            # release again, stranding them there past `stop` forever —
+            # one leaked thread set per partial epoch in a long-lived
+            # process. Workers re-check `stop` right after acquiring.
+            for _ in threads:
+                ahead.release()
             with cond:
                 results.clear()
 
 
 def fetch_dataloader(stage: str, image_size, batch_size: int,
                      data_root: str = "datasets", num_workers: int = 4,
-                     seed: int = 1234,
-                     wire_dtype: str = "float32") -> PrefetchLoader:
+                     seed: int = 1234, wire_dtype: str = "float32",
+                     on_bad_sample: str = "raise",
+                     stall_s: float = 0.0) -> PrefetchLoader:
     """Stage-preset loader, the fetch_dataloader analog (datasets.py:199).
 
     Default stays float32 (the stable public contract — batches safe for
@@ -188,4 +264,5 @@ def fetch_dataloader(stage: str, image_size, batch_size: int,
     print(f"Training with {len(dataset)} image pairs")
     return PrefetchLoader(dataset, batch_size, shuffle=True,
                           num_workers=num_workers, drop_last=True, seed=seed,
-                          wire_dtype=wire_dtype)
+                          wire_dtype=wire_dtype,
+                          on_bad_sample=on_bad_sample, stall_s=stall_s)

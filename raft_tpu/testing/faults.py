@@ -1,0 +1,214 @@
+"""Deterministic fault injection for crash-safety drills.
+
+The robustness layer (watchdog, atomic checkpoints, supervisor, loader
+policies) is only trustworthy if its failure paths actually run — in CI
+and in scheduled drills, not just in outages. This module arms *named*
+fault sites in production code paths from a deterministic JSON plan::
+
+    RAFT_FAULT_PLAN='[{"site": "ckpt.msgpack_write", "at": 2,
+                       "kind": "crash"}]' python -m raft_tpu.cli.train ...
+
+Plan entries (a list of dicts, or ``{"faults": [...]}``):
+
+``site``
+    Named injection point. In-repo sites: ``loader.sample`` (per-sample
+    decode in PrefetchLoader workers), ``trainer.step`` (top of the
+    training loop, once per step), ``ckpt.msgpack_write`` (weights-only
+    msgpack writes — ``kind="crash"`` dies in the window between the
+    fsync'd tmp file and the rename, ``kind="corrupt"`` smashes the
+    completed file on disk, i.e. post-save bit rot the load-time
+    manifest check must catch), ``ckpt.orbax_save`` (full-state saves —
+    ``kind="corrupt"`` smashes a file of the just-written step).
+``at``
+    1-based occurrence at which the fault fires (default 1). Each entry
+    fires exactly once.
+``kind``
+    ``"raise"`` (FaultInjected), ``"hang"`` (sleep ``hang_s``, default
+    effectively forever — what a half-up backend looks like),
+    ``"crash"`` (``os._exit(CRASH_EXIT_CODE)``: no atexit, no finally —
+    simulated power loss / preemption), ``"corrupt"`` (byte corruption
+    at sites that write data).
+``attempt``
+    Optional supervisor attempt index (0-based) this entry arms in,
+    matched against $RAFT_SUPERVISOR_ATTEMPT (set by
+    ``training.supervisor`` for each child). Entries without it arm in
+    every attempt. This is how a drill wedges the first run and lets the
+    restarted run recover clean.
+
+Disarmed cost is one module-global ``is None`` check per call — the
+plan machinery never touches the hot path unless armed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+#: simulated abrupt process death (power-loss / preemption stand-in);
+#: distinct from WEDGED_EXIT_CODE so runbooks and the supervisor can
+#: tell a drill's injected crash from a real wedge
+CRASH_EXIT_CODE = 41
+
+_POINT_KINDS = ("raise", "hang", "crash")
+_ALL_KINDS = _POINT_KINDS + ("corrupt",)
+
+
+class FaultInjected(RuntimeError):
+    """Raised at an armed ``kind="raise"`` fault site."""
+
+
+class _Entry:
+    __slots__ = ("site", "at", "kind", "hang_s", "seen", "fired")
+
+    def __init__(self, spec: dict):
+        self.site = spec["site"]
+        self.at = int(spec.get("at", 1))
+        self.kind = spec["kind"]
+        self.hang_s = float(spec.get("hang_s", 3600.0))
+        if self.kind not in _ALL_KINDS:
+            raise ValueError(
+                f"fault kind {self.kind!r} at site {self.site!r}: choose "
+                f"one of {_ALL_KINDS}")
+        if self.at < 1:
+            raise ValueError(f"fault at={self.at} at site {self.site!r}: "
+                             "occurrence counts are 1-based")
+        self.seen = 0
+        self.fired = False
+
+
+_PLAN: Optional[List[_Entry]] = None
+_LOCK = threading.Lock()
+
+
+def arm(plan) -> None:
+    """Arm ``plan`` (list of entry dicts, or ``{"faults": [...]}``);
+    entries scoped to a different supervisor attempt are dropped."""
+    global _PLAN
+    if isinstance(plan, dict):
+        plan = plan.get("faults", [])
+    attempt = int(os.environ.get("RAFT_SUPERVISOR_ATTEMPT", "0"))
+    entries = [_Entry(spec) for spec in plan
+               if int(spec.get("attempt", attempt)) == attempt]
+    _PLAN = entries or None
+
+
+def disarm() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def arm_from_env() -> None:
+    """Arm from $RAFT_FAULT_PLAN (inline JSON) or $RAFT_FAULT_PLAN_FILE."""
+    raw = os.environ.get("RAFT_FAULT_PLAN")
+    if not raw:
+        path = os.environ.get("RAFT_FAULT_PLAN_FILE")
+        if path:
+            with open(path, encoding="utf-8") as fh:
+                raw = fh.read()
+    if raw:
+        arm(json.loads(raw))
+
+
+def armed(site: str) -> bool:
+    """True iff an un-fired entry for ``site`` exists. Lets a call site
+    gate expensive setup (e.g. waiting out an async save so there are
+    bytes on disk to corrupt) on the drill actually being live."""
+    if _PLAN is None:
+        return False
+    with _LOCK:
+        return any(e.site == site and not e.fired for e in _PLAN)
+
+
+def _match(site: str, kinds) -> Optional[_Entry]:
+    """Count this call against every matching entry; return the first
+    (if any) whose occurrence just came due. Each call type counts only
+    the kinds it can serve, so a site with both a ``fault_point`` and a
+    ``fault_file`` call per event still counts one occurrence per event
+    for every entry."""
+    due = None
+    with _LOCK:
+        for e in _PLAN or ():
+            if e.site != site or e.fired or e.kind not in kinds:
+                continue
+            e.seen += 1
+            if due is None and e.seen >= e.at:
+                e.fired = True
+                due = e
+    return due
+
+
+def fault_point(site: str) -> None:
+    """crash/hang/raise injection point — no-op unless a plan is armed."""
+    if _PLAN is None:
+        return
+    e = _match(site, _POINT_KINDS)
+    if e is None:
+        return
+    if e.kind == "raise":
+        raise FaultInjected(
+            f"injected fault at {site} (occurrence {e.at})")
+    if e.kind == "hang":
+        time.sleep(e.hang_s)
+        return
+    # "crash": skip atexit handlers, finally blocks, buffered writes —
+    # exactly what power loss or a SIGKILL preemption leaves behind
+    os._exit(CRASH_EXIT_CODE)
+
+
+def fault_file(site: str, path: str) -> Optional[str]:
+    """Corruption injection point for a completed on-disk artifact:
+    zero-fills ``path``. For a directory, the victim is a ``_METADATA``
+    file if one exists (Orbax step dirs), else the largest file under
+    it — the one most likely to straddle real bit rot or a torn write.
+    Call sites place this AFTER the artifact and any integrity manifest
+    are fully written: the drill models damage the loader-side check
+    must catch, not damage the writer knew about.
+
+    Size-preserving zero-fill rather than bit flips or truncation, on
+    purpose: all three are detected identically by size/hash checks,
+    but feeding flipped bytes to a compressed-stream reader
+    (tensorstore's zstd path) or short-reading a manifest-declared
+    byte range (truncation) corrupts the reader's heap and SIGABRTs
+    the process minutes later — the drill must let the fallback path
+    run, not poison it.
+
+    The ``_METADATA`` preference exists for the same reason one level
+    up: even a *cleanly reported* tensorstore read error against a
+    zeroed data file leaves the async read machinery's heap poisoned
+    (use-after-free; glibc "corrupted double-linked list" aborts later
+    in the very process that must then recover), whereas a zeroed
+    ``_METADATA`` fails the restore in pure-Python parsing before any
+    tensorstore data read starts. Detection coverage is identical —
+    the fallback/quarantine path can't tell which file was bad.
+
+    Returns the victim's path when the entry fired, else None."""
+    if _PLAN is None:
+        return None
+    if _match(site, ("corrupt",)) is None:
+        return None
+    victim = path
+    if os.path.isdir(path):
+        victim, size, meta = None, -1, None
+        for root, _, files in os.walk(path):
+            for f in sorted(files):
+                p = os.path.join(root, f)
+                if f == "_METADATA" and meta is None:
+                    meta = p
+                s = os.path.getsize(p)
+                if s > size:
+                    victim, size = p, s
+        victim = meta or victim
+        if victim is None:
+            return None
+    n = os.path.getsize(victim)
+    with open(victim, "r+b") as fh:
+        fh.write(b"\x00" * n if n else b"\x00")
+    return victim
+
+
+# a process launched with a plan in its environment is armed on first
+# import — no code change needed at the drilled entrypoint
+arm_from_env()

@@ -18,6 +18,8 @@ param's torch key, so missing/mismatched keys fail loudly.
 
 from __future__ import annotations
 
+import hashlib
+import os
 import re
 from typing import Any, Dict, Tuple
 
@@ -146,12 +148,82 @@ def load_pth(path: str, config: RAFTConfig,
     return convert_state_dict(state_dict, variables)
 
 
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint file failed its sidecar SHA-256 integrity check."""
+
+
+def manifest_path(path: str) -> str:
+    return path + ".sha256"
+
+
+def write_manifest(path: str, data: bytes) -> None:
+    """Atomic sidecar integrity manifest: ``<sha256hex>  <nbytes>``."""
+    line = f"{hashlib.sha256(data).hexdigest()}  {len(data)}\n"
+    tmp = f"{manifest_path(path)}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(line)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, manifest_path(path))
+
+
+def verify_manifest(path: str, data: bytes) -> None:
+    """Raise :class:`CorruptCheckpointError` when ``path``'s sidecar
+    manifest mismatches ``data`` (flipped bytes, truncation, or a stale
+    manifest from an interrupted save — all refuse-to-load conditions).
+    A missing sidecar passes: pre-hardening checkpoints stay loadable."""
+    try:
+        with open(manifest_path(path), encoding="utf-8") as f:
+            want_digest, want_size = f.read().split()
+    except FileNotFoundError:
+        return
+    except ValueError as e:
+        raise CorruptCheckpointError(
+            f"unparsable integrity manifest {manifest_path(path)}: "
+            f"{e}") from e
+    got = hashlib.sha256(data).hexdigest()
+    if got != want_digest or len(data) != int(want_size):
+        raise CorruptCheckpointError(
+            f"{path} failed its integrity check (manifest "
+            f"{want_digest[:12]}…/{want_size}B vs actual "
+            f"{got[:12]}…/{len(data)}B) — the file is corrupt or torn; "
+            "refusing to load silently-wrong weights")
+
+
 def save_converted(variables: Dict[str, Any], out_path: str) -> None:
-    """Serialize converted variables with flax msgpack."""
+    """Serialize converted variables with flax msgpack — crash-safely.
+
+    The payload lands under a tmp name and is fsync'd before an atomic
+    rename, so a crash mid-save can never leave a truncated file under
+    the final name (the pre-hardening bug: a died ``save_weights``
+    produced a half-written ``.msgpack`` a later resume loaded). A
+    sidecar SHA-256 manifest written after the rename lets
+    :func:`load_converted` detect byte corruption.
+    """
     from flax import serialization
 
-    with open(out_path, "wb") as f:
-        f.write(serialization.to_bytes(variables))
+    from raft_tpu.testing import faults
+
+    data = serialization.to_bytes(variables)
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        # the classic torn-write window: tmp durable, rename pending
+        faults.fault_point("ckpt.msgpack_write")
+        os.replace(tmp, out_path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    write_manifest(out_path, data)
+    # post-save bit-rot drill: damage the COMPLETED artifact so the
+    # load-time manifest check is what has to catch it
+    faults.fault_file("ckpt.msgpack_write", out_path)
 
 
 def load_converted(path: str, config: RAFTConfig,
@@ -162,7 +234,9 @@ def load_converted(path: str, config: RAFTConfig,
     img = jnp.zeros((1, *image_hw, 3))
     variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
     with open(path, "rb") as f:
-        return serialization.from_bytes(variables, f.read())
+        data = f.read()
+    verify_manifest(path, data)
+    return serialization.from_bytes(variables, data)
 
 
 def main(argv=None):

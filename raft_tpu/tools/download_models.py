@@ -25,10 +25,37 @@ MODELS_URL = "https://www.dropbox.com/s/4j4z58wuv8o0mfz/models.zip"
 
 
 def download(url: str, dest: str) -> str:
+    """Fetch ``url`` to ``dest`` with jittered exponential backoff (the
+    Dropbox mirror drops connections under load — a transient error
+    must not fail the whole fetch+convert run) and an atomic landing:
+    the bytes arrive under ``.part`` and only a complete fetch is
+    renamed into place, so a died download can't be mistaken for a zip."""
     import urllib.request
 
-    print(f"downloading {url} -> {dest}")
-    urllib.request.urlretrieve(url, dest)
+    from raft_tpu.utils.retry import retry
+
+    part = dest + ".part"
+
+    def _fetch():
+        print(f"downloading {url} -> {dest}")
+        try:
+            urllib.request.urlretrieve(url, part)
+        except urllib.error.HTTPError as e:
+            if 400 <= e.code < 500:
+                # a 404/403 is deterministic — HTTPError subclasses
+                # OSError, so without this re-wrap a stale URL would
+                # eat all four attempts' backoff before surfacing
+                raise RuntimeError(
+                    f"{url}: HTTP {e.code} {e.reason} — not retrying "
+                    "a client error; is the mirror URL stale?") from e
+            raise
+        os.replace(part, dest)
+
+    # URLError, timeouts, and connection resets are all OSError
+    retry(_fetch, attempts=4, base_s=2.0, max_s=30.0, retry_on=(OSError,),
+          on_retry=lambda k, d, e: print(
+              f"  attempt {k} failed ({e}); retrying in {d:.0f}s",
+              file=sys.stderr))
     return dest
 
 

@@ -11,13 +11,51 @@ kept for eval and for parity with the reference's ``.pth`` lifecycle
 from __future__ import annotations
 
 import os
+import signal
+import subprocess
+import sys
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
+from flax import serialization
 
+from raft_tpu.testing import faults
+from raft_tpu.tools.convert import manifest_path, verify_manifest
+from raft_tpu.training.restore_sandbox import STEP_UNREADABLE_EXIT
 from raft_tpu.training.train_step import RAFTTrainState
+from raft_tpu.utils.ckpt_scan import (preflight_step, quarantine_path,
+                                      step_dirs)
 
+
+class StepDamagedError(RuntimeError):
+    """The restore sandbox judged a specific step unreadable (torn,
+    corrupt, or a crash while reading it) — the ONLY failure class the
+    fallback path may quarantine. Everything else a restore can raise
+    (disk full writing the snapshot, a broken sandbox env, a template
+    mismatch) is not evidence against the step, and quarantining on it
+    would shred an intact checkpoint history over a transient error."""
+
+
+#: sandbox deaths of the poisoned-read crash class — the native-reader
+#: failure modes a torn/corrupt step provokes (SEGV/ABRT/BUS/ILL/FPE)
+#: and therefore evidence AGAINST the step. Deliberately excludes
+#: SIGKILL/SIGTERM: the OOM killer and process managers signal the
+#: sandbox for reasons that say nothing about the step's bytes, and on
+#: a memory-tight host an OOM-SIGKILL per attempt would otherwise
+#: cascade-quarantine the entire intact history.
+_CRASH_SIGNALS = frozenset(int(s) for s in (
+    signal.SIGSEGV, signal.SIGABRT, signal.SIGBUS, signal.SIGILL,
+    signal.SIGFPE))
+
+#: wall-clock budget for one sandbox restore (seconds; env-overridable,
+#: 0 disables). The sandbox runs BEFORE the trainer's HangWatch is
+#: armed, so without a deadline a tensorstore read that BLOCKS on
+#: damaged input (rather than erroring or crashing) would wedge resume
+#: forever with no watchdog to kill it — under a supervisor, eternally.
+_SANDBOX_TIMEOUT_ENV = "RAFT_RESTORE_TIMEOUT_S"
+_SANDBOX_TIMEOUT_DEFAULT_S = 900.0
 
 # one long-lived manager per directory: Orbax saves stay genuinely async
 # (creating + closing a manager per save would block on wait_until_finished)
@@ -58,7 +96,30 @@ def save_train_state(ckpt_dir: str, state: RAFTTrainState,
     """Async save (Orbax) of the full state at ``step``."""
     mgr = _manager(ckpt_dir)
     step = int(state.step) if step is None else int(step)
-    mgr.save(step, args=ocp.args.StandardSave(_as_tree(state)))
+    # snapshot to an OWNED host copy before backgrounding the write:
+    # the training step donates its state buffers (train_step
+    # donate_argnums), and on the CPU backend orbax's "copy to host"
+    # phase aliases the live buffer instead of copying — a backgrounded
+    # serialize then races XLA's donation reuse (observed under the
+    # fault drills: checkpoints with torn step values, glibc heap
+    # corruption aborts minutes later). On TPU this device_get is the
+    # same D2H transfer orbax performs synchronously anyway.
+    tree = jax.device_get(_as_tree(state))
+    mgr.save(step, args=ocp.args.StandardSave(tree))
+    if faults.armed("ckpt.orbax_save"):
+        # corruption drills smash the step's on-disk files, which
+        # requires the async save to have finished materializing them;
+        # the wait runs only while a drill is live
+        mgr.wait_until_finished()
+        path = os.path.abspath(ckpt_dir)
+        for s, name in step_dirs(path):
+            if s == step:
+                victim = faults.fault_file("ckpt.orbax_save",
+                                           os.path.join(path, name))
+                if victim:
+                    print(f"[faults] corrupted {victim}", flush=True)
+                break
+        faults.fault_point("ckpt.orbax_save")
     if wait:
         mgr.wait_until_finished()
 
@@ -70,20 +131,171 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return _manager(path).latest_step()
 
 
+def _quarantine_step(ckpt_dir: str, step: int) -> Optional[str]:
+    """Rename a torn/corrupt step dir aside (``<dir>.corrupt``) so no
+    future ``latest_step`` can ever hand it out again; returns the new
+    path. Renames, never deletes — the bytes stay around for forensics."""
+    path = os.path.abspath(ckpt_dir)
+    # drop the open manager first: it holds a cached view of (and async
+    # machinery over) the directory being renamed under it
+    mgr = _MANAGERS.pop(path, None)
+    if mgr is not None:
+        try:
+            mgr.wait_until_finished()
+            mgr.close()
+        except Exception:
+            pass  # a broken manager must not block the fallback path
+    for s, name in step_dirs(path):
+        if s != step:
+            continue
+        src = os.path.join(path, name)
+        dst = quarantine_path(src)
+        os.rename(src, dst)
+        return dst
+    return None
+
+
 def restore_train_state(ckpt_dir: str, state: RAFTTrainState,
                         step: Optional[int] = None) -> RAFTTrainState:
     """Restore into the (freshly created) ``state`` template; ``tx`` is
-    rebuilt by the caller's ``create_train_state`` and kept as-is."""
-    mgr = _manager(ckpt_dir)
-    mgr.wait_until_finished()  # a just-issued async save must be visible
-    step = mgr.latest_step() if step is None else step
-    if step is None:
-        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
-    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, _as_tree(state))
-    tree = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-    return state.replace(
-        step=tree["step"], params=tree["params"],
-        batch_stats=tree["batch_stats"], opt_state=tree["opt_state"])
+    rebuilt by the caller's ``create_train_state`` and kept as-is.
+
+    With ``step=None`` (the resume path) this restores the newest
+    *intact* step: a torn or corrupt latest — crash mid-save, bit rot,
+    an injected drill — is quarantined aside with a logged warning and
+    the next-newest step is tried, so auto-resume recovers instead of
+    wedging on (or silently loading) a bad checkpoint. An explicit
+    ``step`` fails loudly: the caller named it, so substituting another
+    would be lying.
+    """
+    path = os.path.abspath(ckpt_dir)
+    mgr = _MANAGERS.get(path)
+    if mgr is not None:
+        mgr.wait_until_finished()  # a just-issued async save must be visible
+
+    def _restore(dir_name: str) -> RAFTTrainState:
+        # the orbax read runs in a throwaway subprocess that
+        # re-serializes the step as an atomic, SHA-256-manifested
+        # msgpack snapshot (restore_sandbox has the full story: a
+        # tensorstore read of a torn/corrupt step poisons the reader's
+        # heap even when it errors cleanly, so the read happens where
+        # death is cheap and detection is an exit code). This trainer
+        # process only ever parses the verified snapshot; its heap
+        # never meets tensorstore's reader.
+        snap = os.path.join(path, f"restore-snapshot.tmp.{os.getpid()}"
+                                  ".msgpack")
+        env = dict(os.environ)
+        # drills target the trainer's own write/read sites, not the
+        # sandbox's re-serialization
+        env.pop("RAFT_FAULT_PLAN", None)
+        env.pop("RAFT_FAULT_PLAN_FILE", None)
+        timeout_s = float(os.environ.get(_SANDBOX_TIMEOUT_ENV,
+                                         _SANDBOX_TIMEOUT_DEFAULT_S))
+        try:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-m",
+                     "raft_tpu.training.restore_sandbox",
+                     os.path.join(path, dir_name), snap],
+                    env=env, capture_output=True, text=True,
+                    timeout=timeout_s or None)
+            except subprocess.TimeoutExpired as exc:
+                # run() has killed the sandbox. A read that blocks past
+                # a generous deadline is the third face of the damaged-
+                # step class (alongside clean errors and native
+                # crashes): the sandbox is CPU-only by construction, so
+                # a wedged backend can't explain it. A systemic IO hang
+                # (dead NFS) would burn timeout_s per step and
+                # quarantine loudly down the history — slow, printed,
+                # and reversible (renames, never deletes) — which beats
+                # the alternative: resume wedged forever with no
+                # watchdog armed yet, a supervisor waiting on a child
+                # that never exits.
+                raise StepDamagedError(
+                    f"restore sandbox for step dir {dir_name!r} hung "
+                    f"past {timeout_s:.0f}s ({_SANDBOX_TIMEOUT_ENV}) "
+                    "and was killed — treating the step as unreadable"
+                ) from exc
+            if proc.returncode != 0:
+                msg = (f"restore sandbox failed for step dir "
+                       f"{dir_name!r} (exit {proc.returncode}): "
+                       f"{proc.stderr.strip()[-500:]}")
+                # a step-unreadable verdict or a sandbox death by a
+                # crash-class signal (the poisoned-read failure modes)
+                # indicts the step; any other failure — ENV_ERROR_EXIT,
+                # usage, import trouble, an OOM/operator SIGKILL or
+                # SIGTERM — indicts the environment and must not feed
+                # the quarantine path
+                if (proc.returncode == STEP_UNREADABLE_EXIT
+                        or -proc.returncode in _CRASH_SIGNALS):
+                    raise StepDamagedError(msg)
+                raise RuntimeError(msg)
+            with open(snap, "rb") as fh:
+                data = fh.read()
+            verify_manifest(snap, data)
+            tree = serialization.from_bytes(_as_tree(state), data)
+            # launder every leaf through an on-device copy so ONLY
+            # XLA-owned buffers reach the donated train step: on this
+            # jaxlib, device_put of host numpy arrays can zero-copy
+            # alias python-owned memory, and donating such a buffer
+            # lets XLA reuse/free memory the allocator doesn't own —
+            # latent heap corruption that aborts the recovered run at
+            # an allocation-layout-dependent point (the fault drills
+            # reproduced this; fresh XLA-created states never crash).
+            tree = jax.tree.map(lambda x: jnp.array(x, copy=True), tree)
+        finally:
+            for p in (snap, manifest_path(snap)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        return state.replace(
+            step=tree["step"], params=tree["params"],
+            batch_stats=tree["batch_stats"], opt_state=tree["opt_state"])
+
+    if step is not None:
+        return _restore(str(step))
+
+    skipped = []
+    while True:
+        dirs = step_dirs(path)
+        if not dirs:
+            raise FileNotFoundError(
+                f"no intact checkpoint under {ckpt_dir}" + (
+                    f" (quarantined corrupt step(s): {skipped})"
+                    if skipped else ""))
+        s, name = dirs[0]
+        # pure-python integrity probe BEFORE orbax opens the step: a
+        # torn/corrupt step fed to the restore machinery can poison
+        # the process heap even when it raises a clean python error.
+        # A step must prove its metadata parses before any native
+        # reader touches it; see ckpt_scan.preflight_step.
+        reason = preflight_step(os.path.join(path, name))
+        restored = None
+        if reason is None:
+            try:
+                restored = _restore(name)
+            except StepDamagedError as exc:
+                # damage past the metadata probe (data-file payloads):
+                # same quarantine-and-fall-back, via the sandbox's
+                # step-unreadable verdict. Deliberately NOT a broad
+                # except: a systemic failure (disk full, broken env)
+                # raising here for every step would otherwise
+                # quarantine the entire intact history and silently
+                # restart training from scratch
+                reason = f"{type(exc).__name__}: {exc}"
+        if reason is not None:
+            dst = _quarantine_step(path, s)
+            skipped.append(s)
+            print(f"checkpoint step {s} under {ckpt_dir} is torn/corrupt "
+                  f"({reason}); quarantined to "
+                  f"{dst or '<step dir not found>'} — falling back to "
+                  "the next newest", flush=True)
+            continue
+        if skipped:
+            print(f"resumed from fallback step {s} (skipped corrupt "
+                  f"step(s) {skipped})", flush=True)
+        return restored
 
 
 def save_weights(path: str, variables: Dict[str, Any]) -> None:

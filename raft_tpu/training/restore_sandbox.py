@@ -1,0 +1,93 @@
+"""Restore one Orbax step in a throwaway process; emit a clean snapshot.
+
+Usage::
+
+    python -m raft_tpu.training.restore_sandbox <step_dir> <out_msgpack>
+
+Why a subprocess at all: a tensorstore read against a torn/corrupt
+step leaves the reader process's heap poisoned even when the failure
+surfaces as a clean python exception (use-after-free in the async
+read machinery; glibc aborts strike minutes later at an
+allocation-layout-dependent point — observed repeatedly under the
+fault drills). A trainer that restores in-process therefore can't
+recover from data-file damage mechanically: it quarantines, falls
+back ... and then aborts anyway. Exiling every orbax read to a
+process that exits right after makes corruption survivable by
+construction: this child restores the step, re-serializes the tree as
+an atomic flax-msgpack snapshot (tmp + fsync + rename plus SHA-256
+sidecar, via ``tools.convert.save_converted``), and exits — if the
+read poisoned anything, the poison dies here. Exit 0 with the
+verified snapshot on disk is the only success signal; a torn/corrupt
+step surfaces as a nonzero exit (or a crash), which
+``restore_train_state`` turns into quarantine-and-fall-back.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+#: the step itself could not be restored (torn/corrupt/incompatible) —
+#: the caller may quarantine it and fall back to an older step
+STEP_UNREADABLE_EXIT = 4
+#: the snapshot could not be written (disk full, permissions) — an
+#: ENVIRONMENT failure: the step may be perfectly intact, and callers
+#: must surface the error rather than quarantine good history over it
+ENV_ERROR_EXIT = 5
+
+
+def _state_dictify(tree):
+    """Reshape orbax's raw restore tree into flax state-dict form so
+    the trainer can map it straight onto its state template with
+    ``serialization.from_bytes``: sequences become index-keyed dicts
+    (how flax renders the optax tuple chain) and ``None`` — orbax's
+    rendering of empty containers like ``optax.EmptyState`` — becomes
+    the empty dict flax expects."""
+    if isinstance(tree, (list, tuple)):
+        return {str(i): _state_dictify(v) for i, v in enumerate(tree)}
+    if isinstance(tree, dict):
+        return {k: _state_dictify(v) for k, v in tree.items()}
+    if tree is None:
+        return {}
+    return tree
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 2:
+        print("usage: restore_sandbox <step_dir> <out_msgpack>",
+              file=sys.stderr)
+        return 2
+    step_dir, out_path = argv
+    # host-side re-serialization only: never dial a TPU for a restore
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from raft_tpu.utils.platform import respect_cpu_request
+    respect_cpu_request()
+    import orbax.checkpoint as ocp
+
+    from raft_tpu.tools.convert import save_converted
+
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        try:
+            # no target tree: the raw restore yields host arrays in the
+            # saved structure; the trainer maps them back into its state
+            # template with flax's from_bytes ("default" is the
+            # CheckpointManager item name on the save side)
+            tree = ckptr.restore(os.path.join(step_dir, "default"))
+        except Exception:
+            traceback.print_exc()
+            return STEP_UNREADABLE_EXIT
+    finally:
+        ckptr.close()
+    try:
+        save_converted(_state_dictify(tree), out_path)
+    except Exception:
+        traceback.print_exc()
+        return ENV_ERROR_EXIT
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

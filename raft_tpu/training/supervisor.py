@@ -1,0 +1,234 @@
+"""Process-level supervision: relaunch training after wedge/preemption.
+
+``HangWatch`` turns a half-up tunnel wedge into ``exit 3``
+(WEDGED_EXIT_CODE) — but nothing in-repo ever restarted the run, so a
+wedge still ended training and burned the rest of the window
+(OUTAGE_r04/r05). The supervisor closes that loop: it launches the
+training command as a child process and, on a retryable death (wedge,
+preemption signal, simulated power loss), relaunches it after a
+jittered exponential backoff — relying on ``--resume`` plus the
+integrity-checked checkpoint stack to pick up from the newest intact
+step.
+
+Give-up rules (a supervisor must never hot-loop a deterministic crash):
+
+- two consecutive CRASH-class failures (a plain nonzero exit) whose
+  restore point (newest on-disk step) did not advance — the relaunch
+  would replay the same step into the same crash. Wedges (exit 3) and
+  signal deaths are documented-transient classes and never trip this
+  rule (the OUTAGE_r04/r05 tunnel wedge can recur before the first
+  checkpoint ever commits — that must burn restart budget, not be
+  misread as deterministic), and a run with no restore point yet
+  (probe None) has nothing to "replay";
+- ``max_restarts`` exhausted;
+- exit code 2 (usage error) is never retried.
+
+``run()`` returns 0 on eventual success, the child's exit code on
+give-up, or ``128 + signum`` when the final child died to a signal —
+``sys.exit`` of a raw negative ``Popen`` code would be masked to a
+meaningless ``256 - n`` status, breaking the exit-code table.
+
+Operator stop: SIGTERM/SIGINT delivered to the *supervisor* pid are
+forwarded to the current child, and the supervisor exits ``128 +
+signum`` after the child dies instead of restarting it. Without this a
+``kill <supervisor-pid>`` (or a process manager that signals only its
+direct child, not the group) would take down the parent while the
+reparented trainer keeps training — holding the accelerator claim and
+racing any replacement launch on the same checkpoint dir.
+
+Deliberately jax-free: the parent stays a tiny process a wedged backend
+cannot take down, and the restore-point probe is a directory scan
+(utils/ckpt_scan), not an Orbax open whose cached view would go stale
+across children.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, Optional, Sequence
+
+from raft_tpu.testing.faults import CRASH_EXIT_CODE
+from raft_tpu.utils.ckpt_scan import latest_step_on_disk
+from raft_tpu.utils.retry import backoff_delays
+from raft_tpu.utils.watchdog import WEDGED_EXIT_CODE
+
+#: usage errors are deterministic; retrying an argparse failure is noise
+NON_RETRYABLE_EXIT_CODES = (2,)
+
+#: env var telling the child which supervision attempt it is (0-based);
+#: testing.faults scopes drill plan entries to attempts through it
+ATTEMPT_ENV = "RAFT_SUPERVISOR_ATTEMPT"
+
+_NO_FAILURE = object()  # distinct from None: "no checkpoint on disk"
+
+#: operator-stop signals the supervisor forwards to the child rather
+#: than dying around; SIGINT is in the set for non-tty delivery (a tty
+#: ^C already signals the whole foreground group — the forward is then
+#: a harmless duplicate)
+_FORWARD_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+def describe_exit(rc: int) -> str:
+    if rc == WEDGED_EXIT_CODE:
+        return f"child wedged (exit {rc}, no-progress watchdog)"
+    if rc == CRASH_EXIT_CODE:
+        return f"child crashed (exit {rc}, injected fault drill)"
+    if rc < 0:
+        try:
+            name = signal.Signals(-rc).name
+        except ValueError:
+            name = str(-rc)
+        return f"child killed by signal {name} (preemption?)"
+    return f"child died (exit {rc})"
+
+
+class Supervisor:
+    """Run ``argv`` as a supervised child until clean exit or give-up.
+
+    ``ckpt_dir`` (the stage dir) enables the restore-point probe behind
+    the deterministic-crash rule; pass ``probe_step`` to override it,
+    or neither to supervise on ``max_restarts`` alone. ``launch`` and
+    ``sleep`` are injectable for tests.
+    """
+
+    def __init__(self, argv: Sequence[str], *, max_restarts: int = 5,
+                 ckpt_dir: Optional[str] = None,
+                 probe_step: Optional[Callable[[], Optional[int]]] = None,
+                 base_s: float = 1.0, max_s: float = 60.0,
+                 jitter: float = 0.5, rng=None,
+                 launch: Optional[Callable[[int, dict], int]] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.argv = list(argv)
+        self.max_restarts = int(max_restarts)
+        if probe_step is None and ckpt_dir is not None:
+            probe_step = lambda: latest_step_on_disk(ckpt_dir)  # noqa: E731
+        self._probe = probe_step
+        self._delays = backoff_delays(base_s, max_s, jitter=jitter, rng=rng)
+        self._launch = launch if launch is not None else self._spawn
+        self._sleep = sleep
+        self.restarts = 0
+        self._child: Optional[subprocess.Popen] = None
+        self._stop_signal: Optional[int] = None
+
+    def _spawn(self, attempt: int, env: dict) -> int:
+        proc = subprocess.Popen(self.argv, env=env)
+        self._child = proc
+        # a stop can land between the loop-top check and the handle
+        # assignment above — the handler saw _child=None and had
+        # nothing to forward to. Re-check now that the child is
+        # visible, or the fresh child would run a full stage inside
+        # proc.wait() before the stop took effect
+        if self._stop_signal is not None and proc.poll() is None:
+            proc.send_signal(self._stop_signal)
+        try:
+            return proc.wait()
+        finally:
+            self._child = None
+
+    def _on_signal(self, signum, frame) -> None:
+        """SIGTERM/SIGINT handler: forward to the child and remember
+        the stop so the wait loop exits instead of restarting."""
+        self._stop_signal = signum
+        child = self._child
+        if child is not None and child.poll() is None:
+            child.send_signal(signum)
+
+    def _log(self, msg: str) -> None:
+        print(f"[supervisor] {msg}", file=sys.stderr, flush=True)
+
+    @staticmethod
+    def _exit_code(rc: int) -> int:
+        """Map a child's raw ``Popen`` code to a sys.exit-able status:
+        negative (signal death) becomes the shell's ``128 + signum``
+        convention — ``sys.exit(-9)`` would be masked to an undocumented
+        247 that matches nothing in the README exit-code table."""
+        return 128 - rc if rc < 0 else rc
+
+    def run(self) -> int:
+        """Supervise; returns 0 on eventual success, ``128 + signum``
+        on an operator stop (SIGTERM/SIGINT forwarded to the child),
+        else the final child's exit status via :meth:`_exit_code`
+        (callers ``sys.exit`` it — one failure mode, one code, per
+        exit-code discipline)."""
+        installed = {}
+        try:
+            for s in _FORWARD_SIGNALS:
+                installed[s] = signal.signal(s, self._on_signal)
+        except ValueError:
+            pass  # not the main thread (embedded/tests): no handlers
+        try:
+            return self._supervise()
+        finally:
+            for s, prev in installed.items():
+                signal.signal(s, prev)
+
+    def _stopped(self, what: str) -> int:
+        name = signal.Signals(self._stop_signal).name
+        self._log(f"{name} received — {what}, not restarting")
+        return 128 + self._stop_signal
+
+    def _supervise(self) -> int:
+        prev_fail_step = _NO_FAILURE
+        while True:
+            # a stop that landed with no child alive (during backoff,
+            # or before the first spawn) had nothing to forward to —
+            # honoring it only after one more FULL child run would
+            # leave a trainer the operator already killed holding the
+            # accelerator claim for hours
+            if self._stop_signal is not None:
+                return self._stopped("stop requested with no child "
+                                     "running")
+            env = dict(os.environ)
+            env[ATTEMPT_ENV] = str(self.restarts)
+            rc = self._launch(self.restarts, env)
+            if self._stop_signal is not None:
+                outcome = describe_exit(rc) if rc else "child exited clean"
+                return self._stopped(f"forwarded to child ({outcome})")
+            if rc == 0:
+                if self.restarts:
+                    self._log(f"child exited clean after "
+                              f"{self.restarts} restart(s)")
+                return 0
+            why = describe_exit(rc)
+            if rc in NON_RETRYABLE_EXIT_CODES:
+                self._log(f"{why} — usage error, not retrying")
+                return self._exit_code(rc)
+            fail_step = self._probe() if self._probe is not None else None
+            # the deterministic-crash rule judges CRASH-class exits
+            # only: wedges and signal deaths are transient by
+            # definition (and recur at the same step when they strike
+            # faster than the checkpoint cadence), and a None probe
+            # (no checkpoint yet) has nothing to deterministically
+            # replay — both must spend restart budget instead
+            crash_class = rc > 0 and rc != WEDGED_EXIT_CODE
+            if (crash_class and self._probe is not None
+                    and fail_step is not None
+                    and prev_fail_step is not _NO_FAILURE
+                    and fail_step == prev_fail_step):
+                self._log(
+                    f"{why} with the restore point still at step "
+                    f"{fail_step} — same failure twice with no progress "
+                    "is a deterministic crash, giving up")
+                return self._exit_code(rc)
+            prev_fail_step = fail_step if crash_class else _NO_FAILURE
+            if self.restarts >= self.max_restarts:
+                self._log(f"{why} — max_restarts={self.max_restarts} "
+                          "exhausted, giving up")
+                return self._exit_code(rc)
+            self.restarts += 1
+            delay = next(self._delays)
+            self._log(f"{why} — restart {self.restarts}/"
+                      f"{self.max_restarts} (resume point: step "
+                      f"{fail_step}) in {delay:.1f}s")
+            # sliced so a stop signal cuts the backoff short (PEP 475
+            # would otherwise resume a single long sleep to completion
+            # and relaunch); the loop-top check turns it into an exit
+            remaining = delay
+            while remaining > 0 and self._stop_signal is None:
+                chunk = min(remaining, 0.5)
+                self._sleep(chunk)
+                remaining -= chunk

@@ -31,9 +31,11 @@ import numpy as np
 
 from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.parallel.mesh import make_mesh, replicated, shard_batch
+from raft_tpu.testing import faults
 from raft_tpu.training import checkpoint as ckpt_lib
 from raft_tpu.training.logger import Logger
 from raft_tpu.training.optim import onecycle_linear_schedule
+from raft_tpu.utils.ckpt_scan import latest_step_on_disk
 from raft_tpu.training.train_step import (RAFTTrainState, create_train_state,
                                           make_train_step)
 
@@ -84,7 +86,11 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
     state = create_train_state(model_cfg, train_cfg, rng,
                                image_hw=train_cfg.image_size,
                                init_variables=init_variables)
-    if resume and ckpt_lib.latest_step(stage_dir) is not None:
+    # filesystem-truth probe (not ckpt_lib.latest_step): answering
+    # "does any step exist" must not spin up a cached CheckpointManager
+    # that restore_train_state's quarantine path would then have to
+    # tear down before it can rename a bad step dir
+    if resume and latest_step_on_disk(stage_dir) is not None:
         state = ckpt_lib.restore_train_state(stage_dir, state)
         print(f"resumed from step {int(state.step)}", flush=True)
 
@@ -93,7 +99,8 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
         loader = fetch_dataloader(
             train_cfg.stage, train_cfg.image_size, train_cfg.batch_size,
             data_root=train_cfg.data_root, num_workers=train_cfg.num_workers,
-            seed=train_cfg.seed, wire_dtype="uint8")
+            seed=train_cfg.seed, wire_dtype="uint8",
+            on_bad_sample=train_cfg.on_bad_sample, stall_s=train_cfg.stall_s)
 
     mesh = make_mesh()
     step_fn = jax.jit(make_train_step(model_cfg, train_cfg),
@@ -166,6 +173,11 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
 
             while keep_training:
                 for sharded in device_batches(loader):
+                    # crash-safety drill site: a "hang" here is what a
+                    # half-up backend looks like (no beats -> watchdog
+                    # exit 3), a "crash" is preemption mid-step; no-op
+                    # one None-check when no plan is armed
+                    faults.fault_point("trainer.step")
                     if (prof and not profiling
                             and prof[0] <= total_steps < prof[1]):
                         jax.profiler.start_trace(
@@ -249,23 +261,83 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
     return state
 
 
+def _final_intact(final: str) -> bool:
+    """Gate for the skip-completed-stage shortcut: bare existence of a
+    stage's final ``.msgpack`` is not proof it is loadable — post-save
+    bit rot (or a stale sidecar from an interrupted save) produces a
+    file the NEXT stage's ``load_weights`` rejects at startup, before
+    any checkpoint advances, which the supervisor then reads as a
+    deterministic crash and gives up on: the curriculum is permanently
+    wedged until someone deletes the file by hand. Verify the manifest
+    up front instead; a failing final is quarantined aside (with its
+    sidecar) so the stage retrains and atomically rewrites it. A
+    missing sidecar passes, matching ``verify_manifest``'s
+    pre-hardening compatibility — the rename in ``save_converted`` is
+    atomic, so a final without a manifest is still a complete file."""
+    from raft_tpu.tools.convert import (CorruptCheckpointError,
+                                        manifest_path, verify_manifest)
+    from raft_tpu.utils.ckpt_scan import quarantine_path
+
+    try:
+        with open(final, "rb") as fh:
+            data = fh.read()
+    except FileNotFoundError:
+        return False  # racing delete: nothing to quarantine, retrain
+    # any other OSError (EIO, EACCES — a flaky mount, not the file)
+    # propagates: an environmental read failure is not evidence
+    # against the artifact and must not feed the quarantine path,
+    # same rule as checkpoint.py's StepDamagedError gating
+    try:
+        verify_manifest(final, data)
+        return True
+    except CorruptCheckpointError as exc:
+        dst = quarantine_path(final)
+        try:
+            os.rename(final, dst)
+            if os.path.exists(manifest_path(final)):
+                os.rename(manifest_path(final), manifest_path(dst))
+        except OSError:
+            pass  # vanished mid-quarantine: retraining overwrites it
+        print(f"existing final at {final} fails its integrity check "
+              f"({type(exc).__name__}: {exc}) — quarantined to {dst}; "
+              "retraining the stage", flush=True)
+        return False
+
+
 def train_curriculum(stages, model_cfg: RAFTConfig, name: str = "raft",
                      mixed: bool = False, loader_factory=None,
-                     **overrides) -> None:
+                     resume: bool = True, **overrides) -> None:
     """`train_standard.sh` / `train_mixed.sh` analog: chain stages, each
     restoring the previous stage's final weights with a fresh schedule
     (train_standard.sh:4-6). ``loader_factory(cfg)`` overrides the stage
-    dataloader (tests / custom data)."""
+    dataloader (tests / custom data).
+
+    Restart semantics (``resume=True``, the default): a stage whose
+    final ``.msgpack`` already exists AND passes its integrity manifest
+    is SKIPPED — its weights still chain into the next stage (a corrupt
+    final is quarantined and the stage retrained, see
+    :func:`_final_intact`) — and the in-progress stage resumes from
+    its newest intact full-state checkpoint. A relaunched multi-day
+    curriculum (wedge, preemption, supervisor restart) repeats no
+    completed work instead of retraining finished stages from scratch.
+    ``resume=False`` forces the old every-stage-from-scratch behavior.
+    """
     from raft_tpu.config import stage_config
 
     prev_final: Optional[str] = None
     for stage in stages:
         cfg = stage_config(stage, mixed=mixed, name=f"{name}-{stage}",
                            restore_ckpt=prev_final, **overrides)
+        final = os.path.join(cfg.checkpoint_dir, f"{cfg.name}.msgpack")
+        if resume and os.path.exists(final) and _final_intact(final):
+            print(f"stage {stage}: final weights already at {final} — "
+                  "skipping (restart of a partially-done curriculum)",
+                  flush=True)
+            prev_final = final
+            continue
         t0 = time.perf_counter()
-        train(model_cfg, cfg,
+        train(model_cfg, cfg, resume=resume,
               loader=loader_factory(cfg) if loader_factory else None)
         print(f"stage {stage} done in {time.perf_counter() - t0:.0f}s",
               flush=True)
-        prev_final = os.path.join(cfg.checkpoint_dir,
-                                  f"{cfg.name}.msgpack")
+        prev_final = final

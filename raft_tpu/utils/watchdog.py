@@ -37,10 +37,17 @@ class HangWatch:
     """
 
     def __init__(self, hang_s: float, label: str = "loop",
-                 interval: float = 30.0,
+                 interval: Optional[float] = None,
                  on_fire: Optional[Callable[[float], None]] = None):
         self.hang_s = float(hang_s)
         self.label = label
+        if interval is None:
+            # check cadence scales with the deadline: production's 30 s
+            # poll cost is unchanged, while the tiny deadlines fault
+            # drills use (hang_s of a few seconds) fire promptly instead
+            # of waiting out a 30 s poll
+            interval = (min(30.0, max(0.25, self.hang_s / 4.0))
+                        if self.hang_s > 0 else 30.0)
         self.interval = interval
         self._on_fire = on_fire
         self._last = time.monotonic()
@@ -61,6 +68,17 @@ class HangWatch:
               "backend wedged (half-up tunnel); exiting "
               f"{WEDGED_EXIT_CODE} so the caller can re-probe",
               file=sys.stderr, flush=True)
+        try:
+            # postmortem: every thread's stack, so the wedge report says
+            # WHERE the loop stuck (compile? device fetch? a lock?)
+            # instead of only that it stuck — os._exit gives no
+            # traceback and the hung threads can't print their own
+            import faulthandler
+
+            faulthandler.dump_traceback(file=sys.stderr)
+            sys.stderr.flush()
+        except Exception:
+            pass  # diagnostics must never block the exit itself
         os._exit(WEDGED_EXIT_CODE)
 
     def _watch(self) -> None:

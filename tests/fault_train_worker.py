@@ -1,0 +1,73 @@
+"""Standalone tiny training run for fault-tolerance drills — the
+subprocess target of tests/test_fault_tolerance.py, NOT a pytest module.
+
+A deterministic single-batch loader drives the REAL ``train()`` so a
+supervised run with an armed fault plan (wedge, checkpoint corruption)
+can be compared bitwise against an uninterrupted control run: with one
+fixed batch, identical seeds, and the step-counter-folded rng, the
+final weights depend only on ``num_steps`` — resume from any intact
+step reproduces the control run exactly.
+
+Mirrors tests/conftest.py's backend setup (cpu, 8 virtual devices,
+persistent 'cputest' compile cache, highest matmul precision) so the
+control and supervised processes share one compiled program.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--log-dir", required=True)
+    p.add_argument("--name", required=True)
+    p.add_argument("--num-steps", type=int, default=4)
+    p.add_argument("--hang-s", type=float, default=0.0)
+    p.add_argument("--resume", action="store_true")
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    from raft_tpu.utils.platform import (enable_persistent_cache,
+                                         respect_cpu_request)
+    respect_cpu_request()
+    import jax
+    jax.config.update("jax_default_matmul_precision", "highest")
+    enable_persistent_cache("cputest")
+
+    import numpy as np
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.training.trainer import train
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "image1": rng.rand(8, 64, 64, 3).astype(np.float32) * 255,
+        "image2": rng.rand(8, 64, 64, 3).astype(np.float32) * 255,
+        "flow": rng.randn(8, 64, 64, 2).astype(np.float32),
+        "valid": np.ones((8, 64, 64), np.float32),
+    }
+
+    class OneBatch:
+        def __iter__(self):
+            return iter([batch])
+
+    cfg = TrainConfig(
+        name=args.name, stage="chairs", lr=1e-4, num_steps=args.num_steps,
+        batch_size=8, image_size=(64, 64), iters=2, val_freq=2, sum_freq=2,
+        hang_s=args.hang_s, checkpoint_dir=args.ckpt_dir,
+        log_dir=args.log_dir, validation=())
+    train(RAFTConfig(small=True), cfg, resume=args.resume,
+          loader=OneBatch())
+
+
+if __name__ == "__main__":
+    main()

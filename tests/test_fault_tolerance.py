@@ -1,0 +1,913 @@
+"""The fault matrix: crash-safe checkpoints, retry/backoff, supervised
+auto-resume, and loader resilience, driven by the deterministic fault
+harness (raft_tpu.testing.faults).
+
+Tier-1 on the CPU mesh with tiny configs, except the end-to-end drill
+(TestSupervisedEndToEnd, ``@pytest.mark.slow`` — run explicitly): an
+armed fault plan wedges the first child at step N and corrupts the
+checkpoint written at step M; the supervisor restarts it, resume falls
+back past the corrupt step, and the finished weights match an
+uninterrupted control run bitwise.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.testing import faults
+from raft_tpu.training.supervisor import ATTEMPT_ENV, Supervisor
+from raft_tpu.utils.ckpt_scan import latest_step_on_disk, step_dirs
+from raft_tpu.utils.retry import backoff_delays, retry
+from raft_tpu.utils.watchdog import WEDGED_EXIT_CODE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "fault_train_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm()
+
+
+class TestFaultPlan:
+    def test_occurrence_counting_and_one_shot(self):
+        faults.arm([{"site": "x", "at": 2, "kind": "raise"}])
+        faults.fault_point("x")  # occurrence 1: below threshold
+        with pytest.raises(faults.FaultInjected, match="occurrence 2"):
+            faults.fault_point("x")
+        faults.fault_point("x")  # fired entries never re-fire
+
+    def test_disarmed_is_noop(self):
+        faults.disarm()
+        faults.fault_point("anything")
+        assert not faults.armed("anything")
+
+    def test_arm_from_env_and_dict_form(self, monkeypatch):
+        monkeypatch.setenv("RAFT_FAULT_PLAN", json.dumps(
+            {"faults": [{"site": "y", "kind": "raise"}]}))
+        faults.arm_from_env()
+        assert faults.armed("y")
+        with pytest.raises(faults.FaultInjected):
+            faults.fault_point("y")
+        assert not faults.armed("y")
+
+    def test_attempt_scoping(self, monkeypatch):
+        plan = [{"site": "a", "kind": "raise", "attempt": 0},
+                {"site": "b", "kind": "raise", "attempt": 1}]
+        monkeypatch.setenv(ATTEMPT_ENV, "1")
+        faults.arm(plan)
+        assert not faults.armed("a") and faults.armed("b")
+        monkeypatch.delenv(ATTEMPT_ENV)
+        faults.arm(plan)  # unset env = attempt 0
+        assert faults.armed("a") and not faults.armed("b")
+
+    def test_invalid_entries_rejected(self):
+        with pytest.raises(ValueError, match="kind"):
+            faults.arm([{"site": "x", "kind": "explode"}])
+        with pytest.raises(ValueError, match="1-based"):
+            faults.arm([{"site": "x", "kind": "raise", "at": 0}])
+
+    def test_fault_file_zeroes_content(self, tmp_path):
+        p = tmp_path / "blob"
+        p.write_bytes(b"A" * 300)
+        faults.arm([{"site": "f", "kind": "corrupt"}])
+        victim = faults.fault_file("f", str(p))
+        assert victim == str(p)
+        # size-preserving zero-fill (see fault_file docstring for why
+        # not bit flips or truncation)
+        assert p.read_bytes() == b"\x00" * 300
+        # dir form: the largest file under the dir is the victim
+        d = tmp_path / "step"
+        d.mkdir()
+        (d / "small").write_bytes(b"s" * 10)
+        (d / "big").write_bytes(b"B" * 400)
+        faults.arm([{"site": "f", "kind": "corrupt"}])
+        assert faults.fault_file("f", str(d)) == str(d / "big")
+        assert (d / "small").read_bytes() == b"s" * 10
+        # ... unless a _METADATA file exists (Orbax step dirs): the
+        # python-parsed metadata is hit so the restore fails before
+        # tensorstore's async data reads can poison the reader's heap
+        (d / "sub").mkdir()
+        (d / "sub" / "_METADATA").write_bytes(b"m" * 20)
+        faults.arm([{"site": "f", "kind": "corrupt"}])
+        assert faults.fault_file("f", str(d)) == str(d / "sub" / "_METADATA")
+        assert (d / "sub" / "_METADATA").read_bytes() == b"\x00" * 20
+
+
+class TestRetry:
+    def test_delays_deterministic_without_jitter(self):
+        import itertools
+        got = list(itertools.islice(
+            backoff_delays(1.0, 8.0, jitter=0.0), 6))
+        assert got == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+
+    def test_jitter_bounds(self):
+        import itertools
+        import random
+        got = list(itertools.islice(
+            backoff_delays(1.0, 8.0, jitter=0.5, rng=random.Random(7)), 50))
+        caps = [1.0, 2.0, 4.0] + [8.0] * 47
+        for d, cap in zip(got, caps):
+            assert 0.5 * cap <= d <= 1.5 * cap
+
+    def test_retries_then_succeeds(self):
+        calls, sleeps = [], []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        seen = []
+        assert retry(flaky, attempts=4, jitter=0.0, base_s=1.0,
+                     on_retry=lambda k, d, e: seen.append((k, d)),
+                     sleep=sleeps.append) == "ok"
+        assert len(calls) == 3 and sleeps == [1.0, 2.0]
+        assert seen == [(1, 1.0), (2, 2.0)]
+
+    def test_exhausted_reraises_last(self):
+        with pytest.raises(OSError, match="always"):
+            retry(lambda: (_ for _ in ()).throw(OSError("always")),
+                  attempts=3, jitter=0.0, sleep=lambda d: None)
+
+    def test_only_listed_exceptions_retried(self):
+        def boom():
+            raise KeyError("no")
+
+        with pytest.raises(KeyError):
+            retry(boom, attempts=5, retry_on=(OSError,),
+                  sleep=lambda d: None)
+
+
+class TestMsgpackIntegrity:
+    """Atomic weights-only writes + the SHA-256 sidecar manifest."""
+
+    VARS = {"params": {"w": np.arange(64, dtype=np.float32)}}
+    VARS2 = {"params": {"w": np.ones(64, dtype=np.float32)}}
+
+    def test_save_writes_manifest_and_verifies(self, tmp_path):
+        from raft_tpu.tools import convert
+
+        path = str(tmp_path / "w.msgpack")
+        convert.save_converted(self.VARS, path)
+        data = open(path, "rb").read()
+        convert.verify_manifest(path, data)  # intact: no raise
+        assert os.path.exists(convert.manifest_path(path))
+
+    def test_missing_manifest_tolerated(self, tmp_path):
+        from raft_tpu.tools import convert
+
+        path = str(tmp_path / "legacy.msgpack")
+        path_data = b"pre-hardening checkpoint"
+        open(path, "wb").write(path_data)
+        convert.verify_manifest(path, path_data)  # no sidecar: passes
+
+    def test_flipped_byte_detected(self, tmp_path):
+        from raft_tpu.tools import convert
+
+        path = str(tmp_path / "w.msgpack")
+        convert.save_converted(self.VARS, path)
+        data = bytearray(open(path, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        with pytest.raises(convert.CorruptCheckpointError,
+                           match="integrity"):
+            convert.verify_manifest(path, bytes(data))
+
+    def test_interrupted_rename_leaves_final_intact(self, tmp_path):
+        """An interruption in the tmp->rename window must leave the
+        previous final file byte-identical (and no tmp litter on the
+        exception path)."""
+        from raft_tpu.tools import convert
+
+        path = str(tmp_path / "w.msgpack")
+        convert.save_converted(self.VARS, path)
+        before = open(path, "rb").read()
+        faults.arm([{"site": "ckpt.msgpack_write", "kind": "raise"}])
+        with pytest.raises(faults.FaultInjected):
+            convert.save_converted(self.VARS2, path)
+        assert open(path, "rb").read() == before
+        convert.verify_manifest(path, before)
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+    def test_bitrot_drill_caught_by_manifest(self, tmp_path):
+        """kind="corrupt" smashes the COMPLETED file (post-manifest), so
+        the load-time check is what must catch it."""
+        from raft_tpu.tools import convert
+
+        path = str(tmp_path / "w.msgpack")
+        faults.arm([{"site": "ckpt.msgpack_write", "kind": "corrupt"}])
+        convert.save_converted(self.VARS, path)
+        with pytest.raises(convert.CorruptCheckpointError):
+            convert.verify_manifest(path, open(path, "rb").read())
+
+    def test_crash_mid_save_never_torn_under_final_name(self, tmp_path):
+        """Real os._exit crash (no finally, no atexit) between tmp and
+        rename: the final name must still hold the PREVIOUS intact save."""
+        script = f"""
+import sys
+sys.path.insert(0, {REPO!r})
+import numpy as np
+from raft_tpu.testing import faults
+from raft_tpu.tools.convert import save_converted
+save_converted({{"params": {{"w": np.zeros(64, np.float32)}}}}, sys.argv[1])
+faults.arm([{{"site": "ckpt.msgpack_write", "kind": "crash"}}])
+save_converted({{"params": {{"w": np.ones(64, np.float32)}}}}, sys.argv[1])
+"""
+        path = str(tmp_path / "w.msgpack")
+        r = subprocess.run([sys.executable, "-c", script, path],
+                           capture_output=True, text=True)
+        assert r.returncode == faults.CRASH_EXIT_CODE, r.stderr[-2000:]
+        from flax import serialization
+
+        from raft_tpu.tools import convert
+
+        data = open(path, "rb").read()
+        convert.verify_manifest(path, data)  # intact, manifest matches
+        restored = serialization.msgpack_restore(data)
+        np.testing.assert_array_equal(restored["params"]["w"],
+                                      np.zeros(64, np.float32))
+
+
+class TestOrbaxFallback:
+    """restore_train_state falls back past a torn/corrupt latest step."""
+
+    @pytest.fixture(scope="class")
+    def state(self):
+        import jax.numpy as jnp
+        import optax
+
+        from raft_tpu.training.train_step import RAFTTrainState
+
+        # handcrafted tiny state, not create_train_state: these tests
+        # exercise save/quarantine/fallback mechanics, which only see
+        # the (step, params, batch_stats, opt_state) tree — a real
+        # model init costs ~10 s of tier-1 budget for no extra
+        # coverage (the slow-marked e2e drill restores the real
+        # thing). adam, not sgd, so opt_state carries real tensors
+        # through the orbax -> sandbox -> msgpack round trip.
+        tx = optax.adam(1e-3)
+        params = {"w": jnp.arange(64.0), "b": jnp.ones((4, 4))}
+        return RAFTTrainState(step=jnp.zeros((), jnp.int32),
+                              params=params, batch_stats={},
+                              opt_state=tx.init(params), tx=tx)
+
+    def test_corrupt_latest_falls_back_and_quarantines(self, tmp_path,
+                                                       state, capsys):
+        from raft_tpu.training import checkpoint as ckpt_lib
+
+        d = str(tmp_path / "stage")
+        s1 = state.replace(step=state.step + 1)
+        s2 = state.replace(step=state.step + 2)
+        # the REAL drill path: the SECOND save corrupts its own step dir
+        faults.arm([{"site": "ckpt.orbax_save", "at": 2,
+                     "kind": "corrupt"}])
+        ckpt_lib.save_train_state(d, s1, wait=True)
+        ckpt_lib.save_train_state(d, s2, wait=True)
+        assert latest_step_on_disk(d) == 2
+
+        restored = ckpt_lib.restore_train_state(d, state)
+        assert int(restored.step) == 1
+        # the bad step was renamed aside, not deleted, and no longer
+        # counts as a restorable step
+        names = os.listdir(d)
+        assert any(n.endswith(".corrupt") for n in names)
+        assert [s for s, _ in step_dirs(d)] == [1]
+        out = capsys.readouterr().out
+        assert "torn/corrupt" in out and "fallback step 1" in out
+
+    def test_explicit_step_fails_loudly(self, tmp_path, state):
+        """A caller-named step must raise, not silently substitute."""
+        from raft_tpu.training import checkpoint as ckpt_lib
+
+        d = str(tmp_path / "stage")
+        faults.arm([{"site": "ckpt.orbax_save", "kind": "corrupt"}])
+        ckpt_lib.save_train_state(d, state.replace(step=state.step + 5),
+                                  wait=True)
+        with pytest.raises(Exception):
+            ckpt_lib.restore_train_state(d, state, step=5)
+        # no quarantine on the explicit path: the caller decides
+        assert not [n for n in os.listdir(d) if n.endswith(".corrupt")]
+
+    def test_env_failure_does_not_quarantine(self, tmp_path, state,
+                                             monkeypatch):
+        """A sandbox failure that is NOT step damage (disk full writing
+        the snapshot, a broken env) must surface as an error — NOT feed
+        the fallback loop, which would quarantine every intact step and
+        silently restart a long run from scratch."""
+        from raft_tpu.training import checkpoint as ckpt_lib
+        from raft_tpu.training.restore_sandbox import ENV_ERROR_EXIT
+
+        d = str(tmp_path / "stage")
+        ckpt_lib.save_train_state(d, state.replace(step=state.step + 1),
+                                  wait=True)
+
+        def fake_run(*a, **kw):
+            return subprocess.CompletedProcess(
+                a, ENV_ERROR_EXIT, stdout="", stderr="disk full")
+
+        monkeypatch.setattr(ckpt_lib.subprocess, "run", fake_run)
+        with pytest.raises(RuntimeError, match="disk full"):
+            ckpt_lib.restore_train_state(d, state)
+        assert not [n for n in os.listdir(d) if n.endswith(".corrupt")]
+        assert [s for s, _ in step_dirs(d)] == [1]  # history intact
+
+    def test_sandbox_timeout_quarantines_hung_step(self, tmp_path, state,
+                                                   monkeypatch):
+        """A tensorstore read that BLOCKS on damaged input (rather than
+        erroring or crashing) runs before the trainer's watchdog is
+        armed — the deadline must turn it into quarantine-and-fall-back
+        instead of an eternal wedge."""
+        from raft_tpu.training import checkpoint as ckpt_lib
+
+        d = str(tmp_path / "stage")
+        ckpt_lib.save_train_state(d, state.replace(step=state.step + 1),
+                                  wait=True)
+        ckpt_lib.save_train_state(d, state.replace(step=state.step + 2),
+                                  wait=True)
+        name2 = {s: n for s, n in step_dirs(d)}[2]
+        real_run = subprocess.run
+
+        def fake_run(cmd, **kw):
+            if os.path.basename(cmd[-2]) == name2:
+                raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
+            return real_run(cmd, **kw)
+
+        monkeypatch.setattr(ckpt_lib.subprocess, "run", fake_run)
+        restored = ckpt_lib.restore_train_state(d, state)
+        assert int(restored.step) == 1
+        assert any(n.endswith(".corrupt") for n in os.listdir(d))
+        assert [s for s, _ in step_dirs(d)] == [1]
+
+    def test_oom_signal_death_does_not_quarantine(self, tmp_path, state,
+                                                  monkeypatch):
+        """SIGKILL/SIGTERM of the sandbox (OOM killer, process manager)
+        says nothing about the step's bytes — on a memory-tight host it
+        recurs for EVERY step, and quarantining on it would shred the
+        entire intact history. It must surface as an error instead."""
+        from raft_tpu.training import checkpoint as ckpt_lib
+
+        d = str(tmp_path / "stage")
+        ckpt_lib.save_train_state(d, state.replace(step=state.step + 1),
+                                  wait=True)
+
+        def fake_run(*a, **kw):
+            return subprocess.CompletedProcess(a, -9, stdout="",
+                                               stderr="oom-killed")
+
+        monkeypatch.setattr(ckpt_lib.subprocess, "run", fake_run)
+        with pytest.raises(RuntimeError, match="oom-killed"):
+            ckpt_lib.restore_train_state(d, state)
+        assert not [n for n in os.listdir(d) if n.endswith(".corrupt")]
+        assert [s for s, _ in step_dirs(d)] == [1]  # history intact
+
+    def test_sandbox_crash_signal_quarantines(self, tmp_path, state,
+                                              monkeypatch):
+        """A SIGSEGV sandbox death IS the poisoned-read crash class the
+        sandbox exists to contain: quarantine and fall back."""
+        from raft_tpu.training import checkpoint as ckpt_lib
+
+        d = str(tmp_path / "stage")
+        ckpt_lib.save_train_state(d, state.replace(step=state.step + 1),
+                                  wait=True)
+        ckpt_lib.save_train_state(d, state.replace(step=state.step + 2),
+                                  wait=True)
+        name2 = {s: n for s, n in step_dirs(d)}[2]
+        real_run = subprocess.run
+
+        def fake_run(cmd, **kw):
+            if os.path.basename(cmd[-2]) == name2:
+                return subprocess.CompletedProcess(cmd, -11, stdout="",
+                                                   stderr="segfault")
+            return real_run(cmd, **kw)
+
+        monkeypatch.setattr(ckpt_lib.subprocess, "run", fake_run)
+        restored = ckpt_lib.restore_train_state(d, state)
+        assert int(restored.step) == 1
+        assert any(n.endswith(".corrupt") for n in os.listdir(d))
+
+    def test_all_steps_corrupt_raises_with_inventory(self, tmp_path,
+                                                     state):
+        from raft_tpu.training import checkpoint as ckpt_lib
+
+        d = str(tmp_path / "stage")
+        faults.arm([{"site": "ckpt.orbax_save", "kind": "corrupt"},
+                    {"site": "ckpt.orbax_save", "at": 2,
+                     "kind": "corrupt"}])
+        ckpt_lib.save_train_state(d, state.replace(step=state.step + 1),
+                                  wait=True)
+        ckpt_lib.save_train_state(d, state.replace(step=state.step + 2),
+                                  wait=True)
+        with pytest.raises(FileNotFoundError, match="quarantined"):
+            ckpt_lib.restore_train_state(d, state)
+
+
+class _ListDataset:
+    """Tiny tuple-sample dataset with optional bad/slow indices."""
+
+    def __init__(self, n=8, bad=(), slow=(), slow_s=8.0):
+        self.n = n
+        self.bad = set(bad)
+        self.slow = set(slow)
+        self.slow_s = slow_s
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i in self.bad:
+            raise ValueError(f"rotten sample {i}")
+        if i in self.slow:
+            time.sleep(self.slow_s)
+        img = np.zeros((8, 8, 3), np.float32)
+        flow = np.zeros((8, 8, 2), np.float32)
+        valid = np.ones((8, 8), np.float32)
+        return img, img, flow, valid
+
+
+class TestLoaderResilience:
+    def _loader(self, ds, **kw):
+        from raft_tpu.data.loader import PrefetchLoader
+
+        kw.setdefault("shuffle", False)
+        kw.setdefault("num_workers", 2)
+        kw.setdefault("clamp", False)
+        return PrefetchLoader(ds, batch_size=4, **kw)
+
+    def test_skip_policy_resamples_and_counts(self):
+        loader = self._loader(_ListDataset(bad={3}), on_bad_sample="skip")
+        with pytest.warns(UserWarning, match="skipped bad sample 3"):
+            batches = list(loader)
+        assert len(batches) == 2
+        assert all(b["image1"].shape == (4, 8, 8, 3) for b in batches)
+        assert loader.bad_samples >= 1
+
+    def test_raise_policy_surfaces_decode_error(self):
+        loader = self._loader(_ListDataset(bad={3}))  # default: raise
+        with pytest.raises(ValueError, match="rotten sample 3"):
+            list(loader)
+
+    def test_systematically_broken_dataset_gives_up(self):
+        loader = self._loader(_ListDataset(bad=set(range(8))),
+                              on_bad_sample="skip")
+        with pytest.warns(UserWarning):
+            with pytest.raises(RuntimeError,
+                               match="systematically broken"):
+                list(loader)
+
+    def test_stall_deadline_raises_named_error(self):
+        from raft_tpu.data.loader import LoaderStallError
+
+        loader = self._loader(_ListDataset(slow={0}, slow_s=8.0),
+                              num_workers=1, stall_s=0.75)
+        t0 = time.monotonic()
+        with pytest.raises(LoaderStallError, match="stall_s"):
+            list(loader)
+        assert time.monotonic() - t0 < 5.0  # surfaced, not an 8s hang
+
+    def test_no_worker_thread_leak_on_early_exit(self):
+        """Workers parked in ahead.acquire() must observe stop after an
+        early consumer exit instead of leaking one thread set per
+        partial epoch."""
+        before = set(threading.enumerate())
+        loader = self._loader(_ListDataset(n=32), prefetch=1)
+        it = iter(loader)
+        next(it)
+        it.close()  # early exit mid-epoch
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            leaked = [t for t in threading.enumerate()
+                      if t not in before and t.is_alive()
+                      and t.name.startswith("PrefetchLoader")]
+            if not leaked:
+                break
+            time.sleep(0.05)
+        assert not leaked, f"leaked worker threads: {leaked}"
+
+    def test_fault_site_in_worker_respects_skip_policy(self):
+        faults.arm([{"site": "loader.sample", "kind": "raise"}])
+        loader = self._loader(_ListDataset(), on_bad_sample="skip",
+                              num_workers=1)
+        with pytest.warns(UserWarning, match="FaultInjected"):
+            batches = list(loader)
+        assert len(batches) == 2 and loader.bad_samples == 1
+
+
+class TestSupervisorUnit:
+    def _sup(self, rcs, probes, **kw):
+        seq = iter(rcs)
+        probe_seq = iter(probes)
+        launches = []
+
+        def launch(attempt, env):
+            launches.append(env[ATTEMPT_ENV])
+            return next(seq)
+
+        sup = Supervisor(["true"], launch=launch,
+                         probe_step=lambda: next(probe_seq),
+                         sleep=lambda d: None, **kw)
+        return sup, launches
+
+    def test_restart_on_wedge_then_success(self):
+        sup, launches = self._sup([WEDGED_EXIT_CODE, 0], [4])
+        assert sup.run() == 0
+        assert launches == ["0", "1"] and sup.restarts == 1
+
+    def test_two_crashes_same_step_is_deterministic(self):
+        sup, launches = self._sup([1, 1, 1], [5, 5, 5], max_restarts=10)
+        assert sup.run() == 1
+        assert len(launches) == 2  # gave up, didn't burn the budget
+
+    def test_crashes_with_no_checkpoint_yet_spend_budget(self):
+        """probe None == None must NOT read as 'deterministic': a crash
+        before the first checkpoint commits (the OUTAGE-r04 shape) has
+        no restore point to replay — it spends restart budget instead
+        of abandoning the run after one restart."""
+        sup, launches = self._sup([1, 1, 1], [None, None, None],
+                                  max_restarts=2)
+        assert sup.run() == 1
+        assert len(launches) == 3  # initial + max_restarts
+
+    def test_repeated_wedges_same_step_keep_retrying(self):
+        """Wedges (exit 3) are transient by definition — two at the
+        same restore point (they recur faster than the checkpoint
+        cadence) must not trip the deterministic-crash rule."""
+        sup, launches = self._sup([WEDGED_EXIT_CODE, WEDGED_EXIT_CODE, 0],
+                                  [7, 7, 7], max_restarts=5)
+        assert sup.run() == 0
+        assert sup.restarts == 2
+
+    def test_final_signal_death_maps_to_128_plus_signum(self):
+        """sys.exit(-9) would be masked to an undocumented 247; the
+        supervisor returns the shell convention instead."""
+        sup, launches = self._sup([-9, -9], [1, 2], max_restarts=1)
+        assert sup.run() == 137  # 128 + SIGKILL
+
+    def test_progressing_failures_use_full_budget(self):
+        sup, launches = self._sup([1] * 10, [1, 2, 3, 4, 5, 6],
+                                  max_restarts=3)
+        assert sup.run() == 1
+        assert len(launches) == 4  # initial + max_restarts
+
+    def test_usage_error_never_retried(self):
+        sup, launches = self._sup([2], [99])
+        assert sup.run() == 2
+        assert len(launches) == 1
+
+    def test_preemption_signal_retried(self):
+        sup, launches = self._sup([-15, 0], [7])
+        assert sup.run() == 0
+        assert sup.restarts == 1
+
+    def test_operator_signal_forwarded_not_restarted(self):
+        """SIGTERM to the supervisor pid must reach the child and stop
+        the loop — not orphan a trainer that keeps the accelerator
+        claim while the job looks dead."""
+        import signal as signal_mod
+
+        forwarded = []
+
+        class FakeChild:
+            def poll(self):
+                return None
+
+            def send_signal(self, signum):
+                forwarded.append(signum)
+
+        def launch(attempt, env):
+            sup._child = FakeChild()
+            sup._on_signal(signal_mod.SIGTERM, None)
+            sup._child = None
+            return -int(signal_mod.SIGTERM)
+
+        sup = Supervisor(["true"], launch=launch, probe_step=lambda: 1,
+                         sleep=lambda d: None, max_restarts=5)
+        assert sup.run() == 128 + int(signal_mod.SIGTERM)
+        assert forwarded == [signal_mod.SIGTERM]
+        assert sup.restarts == 0  # stopped, never restarted
+
+    def test_stop_landing_in_spawn_window_still_forwarded(self):
+        """A stop recorded between the loop-top check and the child-
+        handle assignment (the handler saw _child=None) must reach the
+        just-spawned child — not leave it running a full stage inside
+        proc.wait()."""
+        import signal as signal_mod
+
+        sup = Supervisor(
+            [sys.executable, "-c", "import time; time.sleep(30)"],
+            sleep=lambda d: None)
+        sup._stop_signal = int(signal_mod.SIGTERM)
+        t0 = time.monotonic()
+        rc = sup._spawn(0, dict(os.environ))
+        assert rc == -int(signal_mod.SIGTERM)
+        assert time.monotonic() - t0 < 25  # did not sit out the sleep
+
+    def test_signal_during_backoff_cancels_restart(self):
+        """A stop landing in the restart-backoff window (no child
+        alive to forward to) must cut the wait short and end the loop
+        — not be honored only after one more FULL child run."""
+        import signal as signal_mod
+
+        launches = []
+
+        def launch(attempt, env):
+            launches.append(attempt)
+            return WEDGED_EXIT_CODE
+
+        def sleep(d):
+            sup._on_signal(signal_mod.SIGTERM, None)
+
+        sup = Supervisor(["true"], launch=launch, probe_step=lambda: None,
+                         sleep=sleep, max_restarts=5)
+        assert sup.run() == 128 + int(signal_mod.SIGTERM)
+        assert launches == [0]  # the stop preempted the relaunch
+
+    def test_subprocess_wedge_exit3_restart(self, tmp_path):
+        """The satellite drill: a real child process wedges (tiny
+        hang_s watchdog -> exit 3), the supervisor relaunches it, and
+        the second attempt succeeds. jax-free and fast."""
+        script = f"""
+import os, sys, time
+sys.path.insert(0, {REPO!r})
+from raft_tpu.utils.watchdog import HangWatch
+if int(os.environ.get("RAFT_SUPERVISOR_ATTEMPT", "0")) >= 1:
+    sys.exit(0)
+HangWatch(0.4, label="drill").start()
+time.sleep(30)
+"""
+        sup = Supervisor([sys.executable, "-c", script],
+                         max_restarts=2, probe_step=iter([1, 2]).__next__,
+                         base_s=0.05, max_s=0.1)
+        t0 = time.monotonic()
+        assert sup.run() == 0
+        assert sup.restarts == 1
+        assert time.monotonic() - t0 < 20.0
+
+
+class TestWatchdogPostmortem:
+    def test_wedge_dumps_all_thread_stacks(self):
+        script = """
+import time
+from raft_tpu.utils.watchdog import HangWatch
+HangWatch(0.3, label="pm").start()
+time.sleep(30)
+"""
+        r = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True,
+            text=True, env=dict(os.environ, PYTHONPATH=REPO))
+        assert r.returncode == WEDGED_EXIT_CODE
+        assert "[watchdog] pm" in r.stderr
+        # faulthandler stack dump shows WHERE the process stuck (the
+        # watchdog thread is "Current thread"; the wedged main thread's
+        # frame is the module-level sleep line)
+        assert "Current thread" in r.stderr
+        assert 'File "<string>", line 5 in <module>' in r.stderr
+
+
+class TestDownloadRetry:
+    def test_transient_failures_then_success(self, tmp_path, monkeypatch):
+        import urllib.request
+
+        from raft_tpu.tools import download_models
+
+        calls = []
+
+        def fake_retrieve(url, dest):
+            calls.append(url)
+            if len(calls) < 3:
+                raise OSError("connection reset")
+            open(dest, "wb").write(b"zipbytes")
+
+        monkeypatch.setattr(urllib.request, "urlretrieve", fake_retrieve)
+        monkeypatch.setattr(time, "sleep", lambda d: None)
+        dest = str(tmp_path / "models.zip")
+        assert download_models.download("http://x/models.zip", dest) == dest
+        assert len(calls) == 3
+        assert open(dest, "rb").read() == b"zipbytes"
+        assert not os.path.exists(dest + ".part")
+
+    def test_permanent_failure_raises(self, tmp_path, monkeypatch):
+        import urllib.request
+
+        from raft_tpu.tools import download_models
+
+        def always_fail(url, dest):
+            raise OSError("refused")
+
+        monkeypatch.setattr(urllib.request, "urlretrieve", always_fail)
+        monkeypatch.setattr(time, "sleep", lambda d: None)
+        with pytest.raises(OSError, match="refused"):
+            download_models.download("http://x/m.zip",
+                                     str(tmp_path / "m.zip"))
+
+
+class TestCurriculumRestart:
+    def _run(self, tmp_path, monkeypatch, stages, pre_done=(), **kw):
+        from raft_tpu.training import trainer
+
+        calls = []
+        ckpt = str(tmp_path / "ckpt")
+        os.makedirs(ckpt, exist_ok=True)
+
+        def fake_train(model_cfg, cfg, resume=False, loader=None):
+            calls.append((cfg.stage, resume, cfg.restore_ckpt))
+            open(os.path.join(ckpt, f"{cfg.name}.msgpack"), "wb").write(b"w")
+
+        monkeypatch.setattr(trainer, "train", fake_train)
+        for stage in pre_done:
+            open(os.path.join(ckpt, f"c-{stage}.msgpack"), "wb").write(b"w")
+        from raft_tpu.config import RAFTConfig
+
+        trainer.train_curriculum(stages, RAFTConfig(small=True), name="c",
+                                 checkpoint_dir=ckpt, **kw)
+        return calls, ckpt
+
+    def test_completed_stage_skipped_and_chained(self, tmp_path,
+                                                 monkeypatch, capsys):
+        calls, ckpt = self._run(tmp_path, monkeypatch,
+                                ["chairs", "things"], pre_done=["chairs"])
+        # chairs not retrained; things restores chairs' existing final
+        assert [c[0] for c in calls] == ["things"]
+        assert calls[0][2] == os.path.join(ckpt, "c-chairs.msgpack")
+        assert "skipping" in capsys.readouterr().out
+
+    def test_in_progress_stage_gets_resume(self, tmp_path, monkeypatch):
+        calls, _ = self._run(tmp_path, monkeypatch, ["chairs", "things"])
+        assert [(c[0], c[1]) for c in calls] == [("chairs", True),
+                                                 ("things", True)]
+
+    def test_resume_false_retrains_everything(self, tmp_path, monkeypatch):
+        calls, _ = self._run(tmp_path, monkeypatch, ["chairs"],
+                             pre_done=["chairs"], resume=False)
+        assert [(c[0], c[1]) for c in calls] == [("chairs", False)]
+
+    def test_corrupt_final_retrained_not_skipped(self, tmp_path,
+                                                 monkeypatch, capsys):
+        """An existing final that fails its integrity manifest must not
+        be trusted by the skip shortcut: the next stage's load would
+        reject it at startup on every restart — a permanently wedged
+        curriculum. Quarantine it and retrain the stage instead."""
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.tools.convert import manifest_path
+        from raft_tpu.training import trainer
+
+        calls = []
+        ckpt = str(tmp_path / "ckpt")
+        os.makedirs(ckpt)
+        final = os.path.join(ckpt, "c-chairs.msgpack")
+        open(final, "wb").write(b"rotten final")
+        open(manifest_path(final), "w").write("0" * 64 + " 999\n")
+
+        def fake_train(model_cfg, cfg, resume=False, loader=None):
+            calls.append(cfg.stage)
+            open(os.path.join(ckpt, f"{cfg.name}.msgpack"),
+                 "wb").write(b"w")
+
+        monkeypatch.setattr(trainer, "train", fake_train)
+        trainer.train_curriculum(["chairs"], RAFTConfig(small=True),
+                                 name="c", checkpoint_dir=ckpt)
+        assert calls == ["chairs"]  # retrained, not skipped
+        names = os.listdir(ckpt)
+        # the bad final (and its stale sidecar) moved aside; the
+        # retrained final sits under the real name
+        assert "c-chairs.msgpack.corrupt" in names
+        assert "c-chairs.msgpack.corrupt.sha256" in names
+        assert open(final, "rb").read() == b"w"
+        assert "retraining the stage" in capsys.readouterr().out
+
+    def test_env_read_error_on_final_surfaces_not_quarantined(
+            self, tmp_path, monkeypatch):
+        """An environmental read failure (EIO on a flaky mount — here
+        simulated by a directory under the final's name) is not
+        evidence against the artifact: it must surface as an error,
+        not quarantine an intact multi-day final and retrain."""
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.training import trainer
+
+        calls = []
+        ckpt = str(tmp_path / "ckpt")
+        os.makedirs(os.path.join(ckpt, "c-chairs.msgpack"))
+        monkeypatch.setattr(
+            trainer, "train",
+            lambda *a, **kw: calls.append(kw) or None)
+        with pytest.raises(OSError):
+            trainer.train_curriculum(["chairs"], RAFTConfig(small=True),
+                                     name="c", checkpoint_dir=ckpt)
+        assert calls == []  # no retrain on an environmental error
+        assert not [n for n in os.listdir(ckpt) if ".corrupt" in n]
+
+
+class TestTrainCLISupervise:
+    def test_parser_exposes_robustness_knobs(self):
+        from raft_tpu.cli.train import build_parser, configs_from_args
+
+        args = build_parser().parse_args(
+            ["--stage", "chairs", "--hang_s", "120", "--supervise",
+             "--max_restarts", "7"])
+        assert args.supervise and args.max_restarts == 7
+        _, tcfg = configs_from_args(args)
+        assert tcfg.hang_s == 120.0
+        # default stays disabled (the stable contract)
+        _, tcfg0 = configs_from_args(
+            build_parser().parse_args(["--stage", "chairs"]))
+        assert tcfg0.hang_s == 0.0
+
+    def test_abbreviated_flags_rejected(self, capsys):
+        """allow_abbrev must stay off: an accepted --superv would
+        survive _strip_flag into the child argv and re-enter the
+        supervisor in every child, recursing forever."""
+        from raft_tpu.cli.train import build_parser
+
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["--stage", "chairs", "--superv"])
+        capsys.readouterr()  # swallow argparse usage noise
+
+    def test_supervise_builds_resumed_child(self, tmp_path, monkeypatch):
+        """--supervise must relaunch THIS cli minus the supervisor flags,
+        with --resume forced, probing the right stage dir."""
+        import raft_tpu.training.supervisor as sup_mod
+        from raft_tpu.cli import train as cli_train
+
+        captured = {}
+
+        class FakeSup:
+            def __init__(self, argv, **kw):
+                captured["argv"] = argv
+                captured.update(kw)
+
+            def run(self):
+                return 0
+
+        monkeypatch.setattr(sup_mod, "Supervisor", FakeSup)
+        argv = ["--stage", "chairs", "--name", "n", "--supervise",
+                "--max_restarts", "2",
+                "--checkpoint_dir", str(tmp_path)]
+        with pytest.raises(SystemExit) as ei:
+            cli_train.main(argv)
+        assert ei.value.code == 0
+        child = captured["argv"]
+        assert child[:3] == [sys.executable, "-m", "raft_tpu.cli.train"]
+        tail = child[3:]
+        assert "--supervise" not in tail and "--max_restarts" not in tail
+        assert "2" not in tail  # the flag's value went with it
+        assert tail[-1] == "--resume"
+        assert captured["max_restarts"] == 2
+        assert captured["ckpt_dir"] == os.path.join(str(tmp_path), "n",
+                                                    "chairs")
+
+
+@pytest.mark.slow  # ~190 s (three subprocess training runs + a real
+# 20 s watchdog wedge) — far past the tier-1 budget on the 2-core CI
+# host. The tier-1 fault matrix above covers every mechanism this
+# composes; run the full drill explicitly:
+#   pytest tests/test_fault_tolerance.py -m slow
+class TestSupervisedEndToEnd:
+    def test_wedge_plus_corruption_resume_parity(self, tmp_path,
+                                                 monkeypatch):
+        """The acceptance drill: fault plan wedges attempt 0 at step 4
+        (watchdog exit 3) after corrupting the step-3 checkpoint; the
+        supervisor restarts, resume quarantines the corrupt step and
+        falls back to step 1, and the finished weights are bitwise
+        identical to an uninterrupted control run."""
+        runs = str(tmp_path / "runs")
+        base = [sys.executable, WORKER, "--log-dir", runs,
+                "--num-steps", "4"]
+        ctl_dir, sup_dir = str(tmp_path / "ctl"), str(tmp_path / "sup")
+
+        # control run doubles as the compile-cache warmer for the
+        # supervised children (same program, persistent cache)
+        r = subprocess.run(base + ["--ckpt-dir", ctl_dir, "--name", "ctl"],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+
+        plan = [{"site": "ckpt.orbax_save", "at": 2, "kind": "corrupt",
+                 "attempt": 0},
+                {"site": "trainer.step", "at": 4, "kind": "hang",
+                 "attempt": 0}]
+        monkeypatch.setenv("RAFT_FAULT_PLAN", json.dumps(plan))
+        stage_dir = os.path.join(sup_dir, "sup", "chairs")
+        sup = Supervisor(
+            base + ["--ckpt-dir", sup_dir, "--name", "sup",
+                    "--hang-s", "20", "--resume"],
+            max_restarts=3, ckpt_dir=stage_dir, base_s=0.2, max_s=0.5)
+        assert sup.run() == 0
+        # >= 1, not == 1: under CPU contention a resumed child can eat
+        # an extra (benign) watchdog restart and still recover — the
+        # parity and quarantine asserts below are the real acceptance
+        assert sup.restarts >= 1
+
+        # the corrupt step-3 checkpoint was quarantined during resume
+        assert any(n.endswith(".corrupt") for n in os.listdir(stage_dir))
+        # ... and rewritten intact by the resumed run
+        assert latest_step_on_disk(stage_dir) == 3
+
+        ctl = open(os.path.join(ctl_dir, "ctl.msgpack"), "rb").read()
+        spv = open(os.path.join(sup_dir, "sup.msgpack"), "rb").read()
+        assert ctl == spv  # restored-state parity, bitwise
