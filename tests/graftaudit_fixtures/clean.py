@@ -1,0 +1,46 @@
+"""The negative fixture: one well-behaved program per audit dimension.
+
+bf16 discipline holds (the weight is cast at the site), the donated
+state threads back out (aliasable), weights ride as arguments, no
+callbacks, and the canary's sweep lands on its documented bucket
+count — every H-rule must stay silent here."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.graftaudit import CanaryResult, Target
+
+
+def _build_step():
+    def step(state, x, w):
+        y = jnp.dot(x, w.astype(jnp.bfloat16))   # cast AT the site
+        return state + y.astype(jnp.float32).sum(), y
+
+    return step, (jnp.zeros((), jnp.float32),
+                  jnp.ones((8, 8), jnp.bfloat16),
+                  jnp.ones((8, 8), jnp.float32))
+
+
+def _build_canary():
+    jf = jax.jit(lambda x: x * 2.0)
+    for _ in range(3):             # same shape: one executable
+        jf(jnp.ones((8,), jnp.float32))
+    return CanaryResult(observed_compiles=jf._cache_size(),
+                        detail="same-shape calls x3")
+
+
+TARGETS = [
+    Target(name="clean_step", build=_build_step, donate_argnums=(0,),
+           compute_dtype="bfloat16"),
+    Target(name="clean_canary", kind="canary", build=_build_canary,
+           expect_compiles=1),
+]
+
+BUDGETS = {
+    "targets": {
+        "clean_step": [
+            # generous: the point is that a budget EXISTS and holds
+            {"band": "whole-step", "match": "", "max_bytes": 10 ** 9},
+        ],
+    },
+}

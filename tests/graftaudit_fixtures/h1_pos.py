@@ -1,0 +1,25 @@
+"""H1 planted violation: a debug callback traced into the step.
+
+The AST linter can't see this when the print hides inside a helper —
+the artifact tier catches the `debug_callback` eqn (and, compiled, the
+host custom-call)."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.graftaudit import Target
+
+
+def _noisy_helper(x):
+    jax.debug.print("step norm {n}", n=jnp.linalg.norm(x))
+    return x * 2.0
+
+
+def _build():
+    def step(x):
+        return _noisy_helper(x).sum()
+
+    return step, (jnp.ones((8, 8), jnp.float32),)
+
+
+TARGETS = [Target(name="h1_fixture", build=_build)]

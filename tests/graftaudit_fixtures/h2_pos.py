@@ -1,0 +1,20 @@
+"""H2 planted violation: promotion widens a bf16 dot to f32.
+
+The weight was left f32 (a forgotten cast); jax's promotion silently
+runs the hot dot in f32 — invisible in source, visible in the jaxpr."""
+
+import jax.numpy as jnp
+
+from tools.graftaudit import Target
+
+
+def _build():
+    def step(x, w):
+        return jnp.dot(x, w).sum()
+
+    return step, (jnp.ones((8, 8), jnp.bfloat16),
+                  jnp.ones((8, 8), jnp.float32))
+
+
+TARGETS = [Target(name="h2_fixture", build=_build,
+                  compute_dtype="bfloat16", compiled=False)]

@@ -1,0 +1,20 @@
+"""H3 planted violation: a shape sweep compiles per request while the
+documentation promises one bucket."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.graftaudit import CanaryResult, Target
+
+
+def _build():
+    jf = jax.jit(lambda x: x * 2.0)
+    for n in (4, 8, 16):       # no bucketing: every shape recompiles
+        jf(jnp.ones((n,), jnp.float32))
+    return CanaryResult(
+        observed_compiles=jf._cache_size(),
+        detail="unbucketed 1-d sweep over lengths 4/8/16")
+
+
+TARGETS = [Target(name="h3_fixture", kind="canary", build=_build,
+                  expect_compiles=1)]

@@ -1,0 +1,26 @@
+"""H4 planted violation: a donated buffer no output can reuse.
+
+The state arg is donated but the step returns only a scalar — the
+donation is declared in source (graftlint's R4 is satisfied!) yet XLA
+has nothing to alias it to, so the buffer is silently copied/dropped."""
+
+import warnings
+
+import jax.numpy as jnp
+
+from tools.graftaudit import Target
+
+
+def _build():
+    def step(state, x):
+        return (x * 2.0).sum()   # state never threads back out
+
+    # jax itself warns about the unusable donation at lower time —
+    # that warning IS the planted condition, not test noise
+    warnings.filterwarnings(
+        "ignore", message=".*donated.*", category=UserWarning)
+    return step, (jnp.ones((64,), jnp.float32),
+                  jnp.ones((8,), jnp.float32))
+
+
+TARGETS = [Target(name="h4_fixture", build=_build, donate_argnums=(0,))]

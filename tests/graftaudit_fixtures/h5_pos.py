@@ -1,0 +1,25 @@
+"""H5 planted violation: the fixture's own budgets file allows 1 KiB
+for the whole module; the program moves far more."""
+
+import jax.numpy as jnp
+
+from tools.graftaudit import Target
+
+
+def _build():
+    def step(x):
+        y = jnp.tanh(x) + 1.0
+        return (y @ y.T).sum()
+
+    return step, (jnp.ones((64, 64), jnp.float32),)
+
+
+TARGETS = [Target(name="h5_fixture", build=_build)]
+
+BUDGETS = {
+    "targets": {
+        "h5_fixture": [
+            {"band": "whole-step", "match": "", "max_bytes": 1024},
+        ],
+    },
+}

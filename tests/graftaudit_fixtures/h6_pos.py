@@ -1,0 +1,22 @@
+"""H6 planted violation: weights captured by closure, baked into the
+executable as a multi-MB literal instead of riding as an argument."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.graftaudit import Target
+
+# real-looking weights: a splat (all-ones) would be rewritten to
+# broadcast(constant(1)) and dodge the trap this fixture plants
+_WEIGHTS = jax.random.normal(jax.random.PRNGKey(0), (512, 1024),
+                             jnp.float32)         # 2 MiB literal
+
+
+def _build():
+    def step(x):
+        return (x @ _WEIGHTS).sum()
+
+    return step, (jnp.ones((4, 512), jnp.float32),)
+
+
+TARGETS = [Target(name="h6_fixture", build=_build)]

@@ -207,6 +207,87 @@ class TestMechanisms:
         assert rc == 2
 
 
+class TestParseCache:
+    """The per-file parse cache (PR 4): content-hash keyed, rules-aware,
+    invalidated by any edit to the linter package itself — an
+    accelerator that can never replay stale findings."""
+
+    BAD = "import os\nos._exit(2)\n"
+
+    def test_cache_replays_then_content_hash_invalidates(self, tmp_path):
+        p = tmp_path / "c.py"
+        p.write_text(self.BAD)
+        cache = tmp_path / "cache.json"
+        first = lint_paths([str(p)], cache_path=str(cache))
+        assert {f.rule for f in first} == {"R6"} and cache.exists()
+        # prove the second run is a HIT: doctor the stored finding and
+        # watch the doctored copy come back
+        data = json.loads(cache.read_text())
+        (key,) = data["files"]
+        data["files"][key][0]["message"] = "FROM-CACHE"
+        cache.write_text(json.dumps(data))
+        assert [f.message for f in
+                lint_paths([str(p)], cache_path=str(cache))] \
+            == ["FROM-CACHE"]
+        # any edit changes the content hash: the entry is dead
+        p.write_text(self.BAD + "# touched\n")
+        fresh = lint_paths([str(p)], cache_path=str(cache))
+        assert [f.message for f in fresh] != ["FROM-CACHE"]
+        assert {f.rule for f in fresh} == {"R6"}
+        # ...and the superseded-content entry is EVICTED, not kept
+        # forever (the cache must not grow by one entry per edit)
+        assert len(json.loads(cache.read_text())["files"]) == 1
+
+    def test_linter_signature_invalidates_whole_cache(self, tmp_path):
+        p = tmp_path / "c.py"
+        p.write_text(self.BAD)
+        cache = tmp_path / "cache.json"
+        lint_paths([str(p)], cache_path=str(cache))
+        data = json.loads(cache.read_text())
+        data["sig"] = "some-older-graftlint"
+        (key,) = data["files"]
+        data["files"][key][0]["message"] = "FROM-STALE-CACHE"
+        cache.write_text(json.dumps(data))
+        # a cache written by a different linter version is ignored
+        # wholesale and rewritten under the current signature
+        findings = lint_paths([str(p)], cache_path=str(cache))
+        assert [f.message for f in findings] != ["FROM-STALE-CACHE"]
+        assert json.loads(cache.read_text())["sig"] != \
+            "some-older-graftlint"
+
+    def test_rule_filter_keys_entries_separately(self, tmp_path):
+        from tools.graftlint.rules import ALL_RULES
+        p = tmp_path / "c.py"
+        p.write_text(self.BAD)
+        cache = tmp_path / "cache.json"
+        r1 = [m for m in ALL_RULES if m.RULE == "R1"]
+        assert lint_paths([str(p)], rules=r1,
+                          cache_path=str(cache)) == []
+        # the R1-filtered empty result must not satisfy a full run
+        assert {f.rule for f in
+                lint_paths([str(p)], cache_path=str(cache))} == {"R6"}
+
+    def test_jobs_parallel_matches_serial(self, tmp_path):
+        files = []
+        for i, body in enumerate([self.BAD, "x = 1\n", self.BAD,
+                                  "def f(:\n"]):
+            p = tmp_path / f"f{i}.py"
+            p.write_text(body)
+            files.append(str(p))
+        assert lint_paths(files, jobs=3) == lint_paths(files)
+
+    def test_cli_flags(self, tmp_path, capsys):
+        p = tmp_path / "c.py"
+        p.write_text(self.BAD)
+        cache = tmp_path / "cache.json"
+        assert main([str(p), "--jobs", "0"]) == 2
+        assert main([str(p), "--no-cache", "--jobs", "2"]) == 1
+        capsys.readouterr()
+        assert main([str(p), "--cache", str(cache)]) == 1
+        assert cache.exists()
+        capsys.readouterr()
+
+
 class TestRepoGate:
     """The actual gate: the linted tree must be clean modulo baseline."""
 

@@ -1,0 +1,23 @@
+"""graftaudit: compiled-artifact invariant checker (jaxpr/HLO tier).
+
+graftlint (tools/graftlint) enforces TPU invariants at the AST level;
+this package audits what the compiler actually PRODUCED — the traced
+jaxpr and XLA's optimized HLO for the real train step, serving
+function, and engine routing — against rules H1-H6 (host transfers,
+fp32 widening, recompile count, donation honored, traffic budgets,
+constant-folding traps). Same shrink-only baseline discipline, plus
+shrink-only per-op-name byte budgets. See tools/graftaudit/core.py.
+"""
+
+from .core import (apply_baseline, audit_targets, load_baseline,
+                   load_budgets, load_fixture_targets, main,
+                   shrink_budgets, write_baseline, write_budgets)
+from .finding import AuditFinding
+from .spec import Artifacts, CanaryResult, Target, Waiver
+
+__all__ = [
+    "AuditFinding", "Artifacts", "CanaryResult", "Target", "Waiver",
+    "apply_baseline", "audit_targets", "load_baseline", "load_budgets",
+    "load_fixture_targets", "main", "shrink_budgets", "write_baseline",
+    "write_budgets",
+]

@@ -1,0 +1,24 @@
+import os
+import sys
+
+# Force the CPU backend BEFORE jax initializes: the audit traces on CPU
+# by contract (tiny shapes; artifact structure is platform-independent)
+# and must never dial the image's remote-TPU tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from tools.graftaudit.artifacts import ensure_cpu  # noqa: E402
+
+ensure_cpu()
+try:
+    # persistent compile cache: the audit's compiles are identical run
+    # to run, so everything after the first invocation is cache hits
+    from raft_tpu.utils.platform import enable_persistent_cache
+
+    enable_persistent_cache("graftaudit")
+except Exception:
+    pass
+
+from tools.graftaudit.core import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

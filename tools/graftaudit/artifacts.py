@@ -1,0 +1,90 @@
+"""Build audit artifacts: trace, lower, and compile targets on CPU.
+
+The audit runs the REAL programs (the train step, the serving path) at
+tiny shapes on the CPU backend — jaxpr and optimized-HLO structure is
+what the rules check, and that structure (callbacks, dtype of dots,
+donation aliasing, constants) is decided at trace/lower time, not by
+the execution platform. The one platform-dependent artifact is the H5
+traffic estimate; its budgets file records which platform anchored it.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .spec import Artifacts, CanaryResult, Target
+
+
+def ensure_cpu():
+    """Force the CPU backend exactly the way tests/conftest.py does:
+    the image's sitecustomize registers the 'axon' remote-TPU plugin in
+    every interpreter and jax would initialize it even under
+    JAX_PLATFORMS=cpu — an audit must never dial (or block on) the
+    tunnel. Safe to call when jax is already imported/configured."""
+    import os
+    import sys
+
+    if "jax" not in sys.modules:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def build_artifacts(target: Target) -> Artifacts:
+    """Trace/lower/compile one target and bundle what the rules need."""
+    jax = ensure_cpu()
+    t0 = time.perf_counter()
+    art = Artifacts()
+    if target.kind == "canary":
+        result = target.build()
+        if not isinstance(result, CanaryResult):
+            raise TypeError(
+                f"canary target {target.name}: build() must return a "
+                f"CanaryResult, got {type(result).__name__}")
+        art.canary = result
+        art.hlo_text = "\n".join(result.hlo_texts)
+    elif target.kind == "trace":
+        fn, args = target.build()
+        art.jaxpr = jax.make_jaxpr(fn)(*args)
+        jitted = jax.jit(fn, donate_argnums=target.donate_argnums)
+        lowered = jitted.lower(*args)
+        art.lowered_text = lowered.as_text()
+        if target.compiled:
+            compiled = lowered.compile()
+            art.hlo_text = compiled.as_text()
+            cost = compiled.cost_analysis()
+            # jaxlib has returned both a bare dict and a 1-elem list of
+            # dicts across versions
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            art.cost = dict(cost or {})
+    else:
+        raise ValueError(f"target {target.name}: unknown kind "
+                         f"{target.kind!r} (trace|canary)")
+    art.seconds = time.perf_counter() - t0
+    return art
+
+
+def iter_subjaxprs(jaxpr):
+    """Yield every eqn of ``jaxpr`` and, recursively, of every jaxpr
+    buried in eqn params (pjit bodies, scan/while bodies, custom_vjp
+    branches, remat) — duck-typed so rule modules stay jax-agnostic."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for j in (v if isinstance(v, (list, tuple)) else [v]):
+                inner = None
+                if hasattr(j, "eqns"):
+                    inner = j
+                elif hasattr(j, "jaxpr") and hasattr(j.jaxpr, "eqns"):
+                    inner = j.jaxpr
+                if inner is not None:
+                    yield from iter_subjaxprs(inner)

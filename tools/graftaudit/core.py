@@ -1,0 +1,323 @@
+"""graftaudit driver: build targets, run rules, baseline + budgets.
+
+Usage (from the repo root; this exact bare invocation is the tier-1
+gate, ``tests/test_graftaudit.py``)::
+
+    python -m tools.graftaudit --json
+
+Exit codes mirror graftlint: 0 clean (modulo baseline), 1 new findings
+or stale baseline entries, 2 usage error. The baseline
+(``tools/graftaudit/baseline.json``) and the H5 budgets
+(``tools/graftaudit/budgets.json``) are both SHRINK-ONLY:
+``--write-baseline`` regenerates the grandfather file after a fix (a
+stale entry fails the gate exactly like graftlint's), and
+``--budget-update`` only ever lowers a band's byte ceiling toward the
+observed traffic — raising either is a hand edit a reviewer sees.
+
+Suppression: findings with no source line can't carry pragmas, so the
+pragma analog is a :class:`~tools.graftaudit.spec.Waiver` on the target
+declaration — rule id + detail substring + REQUIRED justification
+(``tools/graftaudit/targets.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .finding import AuditFinding
+from .spec import Target
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.json")
+DEFAULT_BUDGETS = os.path.join(_HERE, "budgets.json")
+
+
+# -- audit ----------------------------------------------------------------
+
+def audit_targets(targets: Sequence[Target], rules=None,
+                  budgets: Optional[dict] = None,
+                  ) -> Tuple[List[AuditFinding], Dict[str, Dict[str, int]],
+                             Dict[str, float]]:
+    """Run ``rules`` over ``targets``.
+
+    Returns ``(findings, observed, seconds)`` where ``observed`` maps
+    target -> band -> measured bytes (for --budget-update) and
+    ``seconds`` maps target -> artifact build wall time. Waivers are
+    applied here — a waived finding never reaches the baseline logic,
+    same as a pragma'd graftlint finding.
+    """
+    from .artifacts import build_artifacts
+    from .rules import ALL_RULES
+    from .rules import traffic as traffic_rule
+
+    rules = ALL_RULES if rules is None else rules
+    budgets = budgets or {}
+    findings: List[AuditFinding] = []
+    observed: Dict[str, Dict[str, int]] = {}
+    seconds: Dict[str, float] = {}
+    for target in targets:
+        art = build_artifacts(target)
+        seconds[target.name] = art.seconds
+        for mod in rules:
+            for f in mod.check(target, art, budgets):
+                if not target.waived(f.rule, f.detail):
+                    findings.append(f)
+        obs = traffic_rule.observe(target, art, budgets)
+        if obs:
+            observed[target.name] = obs
+    return findings, observed, seconds
+
+
+def load_fixture_targets(path: str
+                         ) -> Tuple[List[Target], Optional[dict]]:
+    """(TARGETS, BUDGETS-or-None) from a fixture module file
+    (tests/graftaudit_fixtures) — fixtures planting H5 violations ship
+    their own tiny budgets dict."""
+    name = "graftaudit_fixture_" + \
+        os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        raise OSError(f"cannot import fixture module {path}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return list(mod.TARGETS), getattr(mod, "BUDGETS", None)
+
+
+# -- baseline (same shrink-only semantics as graftlint's) -----------------
+
+def load_baseline(path: str) -> Counter:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return Counter((e["target"], e["rule"], e["detail"])
+                   for e in data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Iterable[AuditFinding]) -> None:
+    entries = [{"target": k[0], "rule": k[1], "detail": k[2]}
+               for k in sorted(f.key() for f in findings)]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({
+            "comment": "graftaudit grandfathered findings — burn down, "
+                       "never grow; regenerate with --write-baseline "
+                       "after fixing one",
+            "findings": entries,
+        }, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: List[AuditFinding], baseline: Counter,
+                   audited_targets: Optional[Iterable[str]] = None,
+                   ) -> Tuple[List[AuditFinding],
+                              List[Tuple[str, str, str]]]:
+    """(new findings, stale keys). An unconsumed entry whose target WAS
+    audited is stale and fails the run — it would silently grandfather
+    the next reintroduction; an entry for a target outside this run
+    (--targets subset) is merely unchecked."""
+    remaining = Counter(baseline)
+    new: List[AuditFinding] = []
+    for f in findings:
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+        else:
+            new.append(f)
+    if audited_targets is not None:
+        audited = set(audited_targets)
+        checked = (lambda k: k[0] in audited)
+    else:
+        checked = (lambda k: True)
+    stale = sorted(k for k, n in remaining.items() if checked(k)
+                   for _ in range(n))
+    return new, stale
+
+
+# -- budgets --------------------------------------------------------------
+
+def load_budgets(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def shrink_budgets(budgets: dict,
+                   observed: Dict[str, Dict[str, int]]) -> dict:
+    """New budgets dict with every measured band lowered toward its
+    observed traffic (never raised — shrink-only by construction)."""
+    from .rules import traffic as traffic_rule
+
+    out = json.loads(json.dumps(budgets))   # deep copy
+    for tname, entries in out.get("targets", {}).items():
+        for e in entries:
+            got = observed.get(tname, {}).get(e["band"])
+            if got is not None:
+                e["max_bytes"] = traffic_rule.shrink(e, got)
+                e["observed_bytes"] = got
+    return out
+
+
+def write_budgets(path: str, budgets: dict) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(budgets, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# -- CLI ------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftaudit",
+        description="Compiled-artifact invariant checker (rules H1-H6 "
+                    "over the traced jaxpr + optimized HLO of the real "
+                    "train/serving programs; see "
+                    "tools/graftaudit/rules/).")
+    p.add_argument("--baseline", metavar="JSON", default=DEFAULT_BASELINE,
+                   help="grandfather file (default: the committed "
+                        "tools/graftaudit/baseline.json)")
+    p.add_argument("--budgets", metavar="JSON", default=DEFAULT_BUDGETS,
+                   help="H5 traffic budgets (default: the committed "
+                        "tools/graftaudit/budgets.json)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (list of findings)")
+    p.add_argument("--write-baseline", metavar="JSON",
+                   help="write current findings as the new baseline "
+                        "and exit 0")
+    p.add_argument("--budget-update", action="store_true",
+                   help="rewrite --budgets in place with every "
+                        "measured band lowered toward its observed "
+                        "traffic (shrink-only; never raises)")
+    p.add_argument("--targets", metavar="T1,T2",
+                   help="audit only these targets")
+    p.add_argument("--rules", metavar="H1,H2,...",
+                   help="run only these rule ids")
+    p.add_argument("--fixture", metavar="PY",
+                   help="audit the TARGETS of this fixture module "
+                        "instead of the repo registry (no default "
+                        "baseline/budgets)")
+    args = p.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        from .rules import ALL_RULES
+        want = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [m for m in ALL_RULES if m.RULE in want]
+        unknown = want - {m.RULE for m in rules}
+        if unknown:
+            print(f"graftaudit: unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    if args.write_baseline and (args.rules or args.targets):
+        # a filtered regenerate would drop every other rule's/target's
+        # grandfathered entries and fail the next full gate run
+        print("graftaudit: refusing --write-baseline with --rules/"
+              "--targets — regenerate from a full run",
+              file=sys.stderr)
+        return 2
+
+    fixture_budgets = None
+    if args.fixture:
+        try:
+            targets, fixture_budgets = load_fixture_targets(args.fixture)
+        # exec_module can raise anything (ImportError, NameError, a jax
+        # error at module scope) — all of it is "unloadable fixture",
+        # exit 2, never a raw traceback (graftlint R6 discipline)
+        except Exception as exc:  # noqa: BLE001
+            print(f"graftaudit: unloadable fixture {args.fixture}: "
+                  f"{exc}", file=sys.stderr)
+            return 2
+        # fixtures run bare: the committed baseline/budgets describe
+        # the REPO's targets, not a fixture's
+        if args.baseline == DEFAULT_BASELINE:
+            args.baseline = None
+        if args.budgets == DEFAULT_BUDGETS:
+            args.budgets = None
+    else:
+        from .targets import build_targets
+        targets = build_targets()
+    if args.targets:
+        want_t = {t.strip() for t in args.targets.split(",")}
+        unknown_t = want_t - {t.name for t in targets}
+        if unknown_t:
+            print(f"graftaudit: unknown target(s): {sorted(unknown_t)}",
+                  file=sys.stderr)
+            return 2
+        targets = [t for t in targets if t.name in want_t]
+
+    budgets: dict = fixture_budgets or {}
+    if args.budgets:
+        try:
+            budgets = load_budgets(args.budgets)
+        except (OSError, ValueError) as exc:
+            print(f"graftaudit: unreadable budgets {args.budgets}: "
+                  f"{exc}", file=sys.stderr)
+            return 2
+
+    findings, observed, seconds = audit_targets(targets, rules=rules,
+                                                budgets=budgets)
+    for tname, dt in seconds.items():
+        print(f"graftaudit: {tname} audited in {dt:.1f}s",
+              file=sys.stderr)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print(f"graftaudit: wrote {len(findings)} finding(s) to "
+              f"{args.write_baseline}", file=sys.stderr)
+        return 0
+
+    if args.budget_update:
+        if not args.budgets:
+            print("graftaudit: --budget-update needs --budgets",
+                  file=sys.stderr)
+            return 2
+        write_budgets(args.budgets, shrink_budgets(budgets, observed))
+        print(f"graftaudit: budgets re-anchored (shrink-only) in "
+              f"{args.budgets}", file=sys.stderr)
+        # findings still gate below: --budget-update cannot bless a
+        # regression, it only tightens ceilings after an improvement
+
+    stale: List[Tuple[str, str, str]] = []
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"graftaudit: unreadable baseline "
+                  f"{args.baseline}: {exc}", file=sys.stderr)
+            return 2
+        if rules is not None:
+            active = {m.RULE for m in rules}
+            baseline = Counter({k: v for k, v in baseline.items()
+                                if k[1] in active})
+        findings, stale = apply_baseline(
+            findings, baseline,
+            audited_targets=[t.name for t in targets])
+
+    if args.as_json:
+        print(json.dumps([{
+            "target": f.target, "rule": f.rule, "name": f.name,
+            "detail": f.detail, "message": f.message,
+        } for f in findings] + [{
+            "target": k[0], "rule": "B0", "name": "stale-baseline",
+            "detail": k[2],
+            "message": f"stale baseline entry for {k[1]}: {k[2]!r} — "
+                       "regenerate with --write-baseline",
+        } for k in stale], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"graftaudit: {len(findings)} new finding(s)",
+                  file=sys.stderr)
+    if stale:
+        for k in stale:
+            print(f"graftaudit: stale baseline entry {k[0]} [{k[1]}] "
+                  f"{k[2]!r}", file=sys.stderr)
+        print(f"graftaudit: {len(stale)} stale baseline entr(y/ies) — "
+              "the finding was fixed (good!) but the entry must go: "
+              "regenerate with --write-baseline so it cannot "
+              "grandfather a future reintroduction", file=sys.stderr)
+    return 1 if (findings or stale) else 0

@@ -1,0 +1,32 @@
+"""The one record type every graftaudit rule emits.
+
+The graftlint analog (``tools/graftlint/finding.py``) anchors findings
+to source positions; an audit finding anchors to a *target* (a traced
+program) plus a stable ``detail`` string (op path, param index, band
+name) — compiled artifacts have no line numbers, so the detail IS the
+baseline identity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class AuditFinding:
+    target: str    # audit target name, e.g. "train_step"
+    rule: str      # "H1".."H6"
+    name: str      # kebab-case rule name, e.g. "host-transfer-in-step"
+    detail: str    # stable identity inside the artifact (op path, band,
+                   # param index) — line numbers don't exist here
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.target}: {self.rule}[{self.name}] "
+                f"{self.message}")
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: details are derived from op paths and
+        param shapes, which survive recompiles of the same program."""
+        return (self.target, self.rule, self.detail)

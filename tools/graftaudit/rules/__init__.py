@@ -1,0 +1,12 @@
+"""Rule registry: each module exposes RULE, NAME, and
+check(target, artifacts, budgets)."""
+
+from __future__ import annotations
+
+from . import (const_fold, donation, dtype_widen, host_transfer,
+               recompile, traffic)
+
+ALL_RULES = (host_transfer, dtype_widen, recompile, donation, traffic,
+             const_fold)
+
+RULE_IDS = {mod.RULE for mod in ALL_RULES}

@@ -1,0 +1,48 @@
+"""H6: constant-folding traps — multi-MB literals embedded in HLO.
+
+Weights captured by closure (instead of passed as arguments) get baked
+into the executable as literals: every recompile re-uploads them, the
+compile cache keys on their VALUES (a checkpoint swap recompiles the
+world — the exact failure the serving engine's weights-as-args design
+note documents), and XLA may constant-fold through them at compile
+time. Any literal at or above the threshold is flagged; the detail is
+the constant's shape plus its op_name attribution, so a baseline entry
+survives recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import AuditFinding
+from ..spec import Artifacts, Target
+
+RULE = "H6"
+NAME = "constant-folded-weights"
+
+#: 1 MiB: an order of magnitude above any legitimate lookup table in
+#: this codebase, an order of magnitude below the smallest checkpoint
+DEFAULT_LIMIT = 1 << 20
+
+
+def check(target: Target, art: Artifacts, budgets=None
+          ) -> List[AuditFinding]:
+    if not art.hlo_text:
+        return []
+    from tools import hlo_lib
+
+    limit = int((budgets or {}).get("const_limit_bytes", DEFAULT_LIMIT))
+    out: List[AuditFinding] = []
+    seen = set()
+    for rec in hlo_lib.find_large_constants(art.hlo_text, limit):
+        detail = f"{rec['shape']} @ {rec['op_name'] or '(no metadata)'}"
+        if detail in seen:   # same literal re-materialized per module
+            continue
+        seen.add(detail)
+        out.append(AuditFinding(
+            target.name, RULE, NAME, detail,
+            f"{rec['bytes']:,}-byte literal {rec['shape']} baked into "
+            "the executable — a closure-captured array that should be "
+            "an argument (weights-as-args keeps executables KB-sized "
+            "and checkpoint swaps recompile-free)"))
+    return out

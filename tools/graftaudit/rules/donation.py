@@ -1,0 +1,69 @@
+"""H4: donation honored end-to-end.
+
+graftlint's R4 checks that state-threading jits *declare*
+``donate_argnums``; XLA is still free to decline — a donated buffer
+whose dtype/shape matches no output, or one the compiler copies anyway,
+silently doubles peak HBM for that arg with no warning at the call
+site. Ground truth is the optimized module's ``input_output_alias``
+map: every flat argument jax marked donatable in the lowered StableHLO
+(``tf.aliasing_output`` when jax found the match itself,
+``jax.buffer_donor`` when it deferred to XLA) must appear as an aliased
+parameter, or the donation was declined.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..finding import AuditFinding
+from ..spec import Artifacts, Target
+
+RULE = "H4"
+NAME = "donation-declined"
+
+_ARG_RE = re.compile(r"%arg(\d+): tensor<[^>]*>\s*(\{[^{}]*\})?")
+
+
+def declared_donations(lowered_text: str) -> List[int]:
+    """Flat arg indices the lowered module marks as donated."""
+    try:
+        sig = lowered_text[lowered_text.index("@main("):]
+        sig = sig[:sig.index(" -> ")]
+    except ValueError:
+        return []
+    return [int(i) for i, attrs in _ARG_RE.findall(sig)
+            if attrs and ("tf.aliasing_output" in attrs
+                          or "jax.buffer_donor" in attrs)]
+
+
+def check(target: Target, art: Artifacts, budgets=None
+          ) -> List[AuditFinding]:
+    if not (target.donate_argnums and art.lowered_text and art.hlo_text):
+        return []
+    from tools import hlo_lib
+
+    declared = declared_donations(art.lowered_text)
+    out: List[AuditFinding] = []
+    if not declared:
+        # the jit declares donate_argnums but jax dropped every leaf at
+        # lowering (nothing matched) — donation is silently OFF
+        out.append(AuditFinding(
+            target.name, RULE, NAME, "no donatable args survived lowering",
+            f"donate_argnums={target.donate_argnums} declared but the "
+            "lowered module carries no tf.aliasing_output/"
+            "jax.buffer_donor attribute — jax found no output to reuse "
+            "any donated buffer for"))
+        return out
+    aliased = hlo_lib.parse_aliased_params(art.hlo_text)
+    shapes = hlo_lib.parse_entry_param_shapes(art.hlo_text)
+    for ix in declared:
+        if ix in aliased:
+            continue
+        shape = shapes[ix] if ix < len(shapes) else "?"
+        out.append(AuditFinding(
+            target.name, RULE, NAME, f"param {ix} ({shape})",
+            f"arg {ix} ({shape}) was donated but the optimized module's "
+            "input_output_alias map does not cover it — XLA declined "
+            "the donation and this buffer is copied every step"))
+    return out

@@ -1,0 +1,55 @@
+"""H2: unintended dtype widening in a bf16-configured step.
+
+Armed only for targets declaring ``compute_dtype="bfloat16"``: every
+``dot_general``/``conv_general_dilated`` whose result is f32 is flagged
+— on TPU those run at a fraction of the bf16 MXU rate and double the
+operand traffic of the step's heaviest ops. Weak-type promotion (a bare
+python scalar touching a bf16 array) is the classic silent source.
+
+Intentional fp32 islands (the all-pairs correlation GEMM, reference
+parity — core/raft.py:102-103 analog) are waived on the target
+declaration with a justification, mirroring graftlint pragmas. The
+detail key is the eqn's source ``name_stack``, which names the model
+path and survives recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import AuditFinding
+from ..spec import Artifacts, Target
+
+RULE = "H2"
+NAME = "fp32-widening-in-bf16-step"
+
+_WIDE_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+def check(target: Target, art: Artifacts, budgets=None
+          ) -> List[AuditFinding]:
+    if target.compute_dtype != "bfloat16" or art.jaxpr is None:
+        return []
+    from ..artifacts import iter_subjaxprs
+
+    out: List[AuditFinding] = []
+    seen = set()
+    for eqn in iter_subjaxprs(art.jaxpr.jaxpr):
+        if eqn.primitive.name not in _WIDE_PRIMS:
+            continue
+        res = eqn.outvars[0].aval
+        if str(getattr(res, "dtype", "")) != "float32":
+            continue
+        ins = ",".join(str(v.aval.dtype) for v in eqn.invars
+                       if hasattr(v, "aval"))
+        detail = f"{eqn.primitive.name} f32 @ {eqn.source_info.name_stack}"
+        if detail in seen:
+            continue
+        seen.add(detail)
+        out.append(AuditFinding(
+            target.name, RULE, NAME, detail,
+            f"f32 {eqn.primitive.name} (operands {ins}) in a "
+            f"bf16-configured step at {eqn.source_info.name_stack} — "
+            "intentional fp32 islands get a waiver on the target, "
+            "promotion escapes get fixed at the site"))
+    return out

@@ -1,0 +1,60 @@
+"""H1: host transfers/callbacks inside a compiled step.
+
+A callback or infeed/outfeed inside the jitted step serializes the
+device against the host every step — the compiled-artifact form of
+graftlint's R1 (which can only see host syncs written in source; a
+`jax.debug.print` buried three layers into a library helper is
+invisible to the AST but shows up here as a `debug_callback` eqn and a
+host custom-call in the optimized HLO).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import AuditFinding
+from ..spec import Artifacts, Target
+
+RULE = "H1"
+NAME = "host-transfer-in-step"
+
+#: jaxpr primitives that cross the host boundary
+_HOST_PRIMS = ("pure_callback", "io_callback", "debug_callback",
+               "callback", "infeed", "outfeed", "host_callback")
+
+
+def check(target: Target, art: Artifacts, budgets=None
+          ) -> List[AuditFinding]:
+    from ..artifacts import iter_subjaxprs
+
+    out: List[AuditFinding] = []
+    seen = set()
+    if art.jaxpr is not None:
+        for eqn in iter_subjaxprs(art.jaxpr.jaxpr):
+            pname = eqn.primitive.name
+            if not any(pname == p or pname.startswith(p + "_")
+                       for p in _HOST_PRIMS):
+                continue
+            detail = f"{pname} @ {eqn.source_info.name_stack}"
+            if detail in seen:
+                continue
+            seen.add(detail)
+            out.append(AuditFinding(
+                target.name, RULE, NAME, detail,
+                f"'{pname}' primitive traced into the step at "
+                f"{eqn.source_info.name_stack} — every execution "
+                "round-trips the host"))
+    if art.hlo_text:
+        from tools import hlo_lib
+
+        for rec in hlo_lib.find_host_ops(art.hlo_text):
+            detail = f"hlo:{rec['detail']} @ {rec['op_name']}"
+            if detail in seen:
+                continue
+            seen.add(detail)
+            out.append(AuditFinding(
+                target.name, RULE, NAME, detail,
+                f"compiled module contains host-boundary op "
+                f"'{rec['opcode']}' ({rec['detail']}) at "
+                f"{rec['op_name'] or '(no metadata)'}"))
+    return out

@@ -1,0 +1,40 @@
+"""H3: recompile audit — compile-cache count over a canary sweep.
+
+graftlint's R3 guesses retrace hazards from source shape (`jax.jit` in
+a loop, unhashable statics); this rule *measures*: a canary target runs
+the documented shape/batch sweep against the real routing code (the
+serving engine's bucket router, a jitted step fed the loader's wire
+dtypes) and asserts the executable count lands exactly on the
+documented bucket count. Catches both directions — a ragged tail
+compiling per distinct batch (the PR-2 serving regression this
+mechanizes) and a doc that promises more buckets than the router
+builds.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import AuditFinding
+from ..spec import Artifacts, Target
+
+RULE = "H3"
+NAME = "compile-cache-budget"
+
+
+def check(target: Target, art: Artifacts, budgets=None
+          ) -> List[AuditFinding]:
+    if target.kind != "canary" or art.canary is None:
+        return []
+    observed = art.canary.observed_compiles
+    documented = target.expect_compiles
+    if documented is None or observed == documented:
+        return []
+    return [AuditFinding(
+        target.name, RULE, NAME,
+        f"compiles {observed} != documented {documented}",
+        f"canary sweep ({art.canary.detail}) produced {observed} "
+        f"executable(s); the documented bucket count is {documented} — "
+        + ("a shape leak is compiling per request"
+           if observed > documented else
+           "the documented bucketing overstates the router"))]

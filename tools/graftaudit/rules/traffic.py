@@ -1,0 +1,98 @@
+"""H5: per-op-name memory-traffic budgets over the optimized HLO.
+
+The round-5 lesson (PROFILE.md): the step is memory-bound and its cost
+concentrates in a few ``metadata.op_name`` bands — above all the
+refinement scan body, whose per-iteration leak any regression
+multiplies by the iteration count. This rule pins each documented band
+to a byte budget: band traffic is summed with
+``tools/hlo_lib.iter_op_traffic`` (result + operand shapes of every
+instruction whose op_name contains the band's ``match``), and the
+whole-step number comes from XLA's own ``Compiled.cost_analysis()``
+"bytes accessed". Budgets live in ``tools/graftaudit/budgets.json``
+and are SHRINK-ONLY: ``--budget-update`` only ever lowers them toward
+the observed value; raising one is a hand edit that a reviewer sees.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+from ..finding import AuditFinding
+from ..spec import Artifacts, Target
+
+RULE = "H5"
+NAME = "traffic-budget-exceeded"
+
+#: headroom --budget-update leaves above the observed value, absorbing
+#: minor XLA version drift without letting a real regression hide
+HEADROOM = 1.10
+
+
+def observe(target: Target, art: Artifacts, budgets: dict) -> dict:
+    """band name -> observed bytes for every MEASURABLE budget entry of
+    ``target`` (the whole-step entry under the reserved band name
+    'whole-step'; absent from the result when ``cost_analysis`` did not
+    report bytes — ``check`` flags that, it must not read as 0).
+    Memoized on the artifact: ``check`` and the driver's
+    --budget-update sweep share one HLO scan."""
+    from tools import hlo_lib
+
+    if art.traffic_obs is not None:
+        return art.traffic_obs
+    entries = (budgets or {}).get("targets", {}).get(target.name, [])
+    obs: dict = {}
+    if entries and art.hlo_text:
+        for e in entries:
+            if e["band"] == "whole-step":
+                if "bytes accessed" in art.cost:
+                    obs[e["band"]] = int(art.cost["bytes accessed"])
+            else:
+                total, ops = hlo_lib.band_traffic(art.hlo_text,
+                                                  e["match"])
+                # a band whose match string hits NO instruction is not
+                # "0 bytes, under budget" — the op_name scheme drifted
+                # and the band measures nothing
+                if ops:
+                    obs[e["band"]] = total
+    art.traffic_obs = obs
+    return obs
+
+
+def check(target: Target, art: Artifacts, budgets=None
+          ) -> List[AuditFinding]:
+    out: List[AuditFinding] = []
+    observed = observe(target, art, budgets or {})
+    for e in (budgets or {}).get("targets", {}).get(target.name, []):
+        got = observed.get(e["band"])
+        if got is None:
+            # a budget that cannot be measured must fail loudly — a
+            # silent 0 would pass the gate forever (and a later
+            # --budget-update would shrink the ceiling toward 0)
+            out.append(AuditFinding(
+                target.name, RULE, "traffic-unmeasurable",
+                f"band {e['band']} unmeasurable",
+                f"band '{e['band']}' has a committed budget but no "
+                "measurement — the target produced no optimized HLO, "
+                "cost_analysis stopped reporting 'bytes accessed', or "
+                f"the op_name match {e['match']!r} no longer hits any "
+                "instruction (metadata drift): re-point the band or "
+                "move the budget entry"))
+            continue
+        if got <= e["max_bytes"]:
+            continue
+        pct = 100.0 * got / e["max_bytes"] - 100.0
+        out.append(AuditFinding(
+            target.name, RULE, NAME, f"band {e['band']}",
+            f"band '{e['band']}' (op_name ~ {e['match']!r}) moves "
+            f"{got:,} bytes, {pct:.1f}% over its {e['max_bytes']:,}-"
+            "byte budget — shrink the traffic or raise the budget by "
+            "hand with a PROFILE.md-grade justification"))
+    return out
+
+
+def shrink(entry: dict, observed: int) -> int:
+    """New max_bytes after --budget-update: never above the current
+    budget, never below the observed traffic."""
+    return min(entry["max_bytes"],
+               max(observed, math.ceil(observed * HEADROOM)))

@@ -1,0 +1,95 @@
+"""Audit target declarations: what gets traced, and what is waived.
+
+A ``Target`` names one real program (train step, serving function,
+engine canary) plus the *declared* discipline the audit holds it to:
+which args it donates (H4), what dtype its hot path is supposed to run
+in (H2), how many executables its canary is documented to compile (H3).
+
+``Waiver`` is the pragma analog for compiled artifacts. graftlint
+suppresses a finding with a per-line ``# graftlint: disable=RN``
+comment; an audit finding has no source line, so the suppression lives
+on the target declaration instead — rule id, a substring of the
+finding's ``detail``, and a REQUIRED justification, reviewed in the
+same place the target is defined. Like pragmas, waivers are for
+intentional-by-design behavior (the fp32 correlation island), never
+for "we'll fix it later" — that is what the baseline's shrink-only
+burn-down is for.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Waiver:
+    rule: str      # "H2"
+    match: str     # substring of the finding's detail
+    reason: str    # justification — empty reasons are rejected
+
+    def __post_init__(self):
+        if not self.reason.strip():
+            raise ValueError(
+                f"waiver for {self.rule} ({self.match!r}) has no "
+                "justification — waivers document intent or they are "
+                "just silent baselining")
+
+
+@dataclass(frozen=True)
+class CanaryResult:
+    """What a canary target observed when it exercised its program."""
+
+    observed_compiles: int
+    detail: str                      # what was swept, for the finding
+    hlo_texts: Tuple[str, ...] = ()  # executables' optimized HLO, so
+                                     # the artifact rules audit them too
+
+
+@dataclass(frozen=True)
+class Target:
+    """One audited program.
+
+    ``kind="trace"``: ``build()`` returns ``(fn, args)`` — positional
+    example args, real arrays or ``jax.ShapeDtypeStruct``s. The driver
+    traces the jaxpr, lowers with ``donate_argnums``, and (when
+    ``compiled``) compiles for the HLO-tier rules.
+
+    ``kind="canary"``: ``build()`` returns a :class:`CanaryResult`; the
+    target runs its own shape/batch sweep (H3) and hands back any
+    executables' HLO for the artifact rules.
+    """
+
+    name: str
+    build: Callable
+    kind: str = "trace"
+    donate_argnums: Tuple[int, ...] = ()
+    compute_dtype: str = "float32"   # "bfloat16" arms H2
+    compiled: bool = True            # False: jaxpr/lowered tier only
+    expect_compiles: Optional[int] = None   # canary: documented count
+    waivers: Tuple[Waiver, ...] = ()
+    notes: str = ""
+
+    def waived(self, rule: str, detail: str) -> bool:
+        return any(w.rule == rule and w.match in detail
+                   for w in self.waivers)
+
+
+@dataclass
+class Artifacts:
+    """Everything the rules see for one target. ``jaxpr`` is the traced
+    ``ClosedJaxpr``; the texts are jax's lowered StableHLO and XLA's
+    optimized HLO; ``cost`` is ``Compiled.cost_analysis()``'s aggregate
+    dict; ``canary`` is set for canary targets (whose ``hlo_texts``
+    also land in ``hlo_text``, concatenated — the line-scanning rules
+    don't care about module boundaries)."""
+
+    jaxpr: object = None
+    lowered_text: str = ""
+    hlo_text: str = ""
+    cost: Dict[str, float] = field(default_factory=dict)
+    canary: Optional[CanaryResult] = None
+    seconds: float = 0.0             # build wall time, for --json timing
+    traffic_obs: Optional[Dict[str, int]] = None   # H5 observe() memo:
+                                     # the rule and the driver's
+                                     # --budget-update share one scan

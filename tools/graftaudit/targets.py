@@ -1,0 +1,207 @@
+"""The repo's real audit targets: train step, serving path, engine.
+
+Shapes are deliberately tiny (32x32, batch 1, 2 refinement iterations)
+— every invariant the rules check (callbacks traced in, dtype of dots,
+donation aliasing, constants, op_name band structure) is decided by
+program STRUCTURE, which is shape-independent; tiny shapes just make
+the CPU trace/compile fit the tier-1 budget. The one scale-sensitive
+artifact, H5's byte numbers, is pinned at exactly these shapes by
+``budgets.json`` (platform/shape recorded there).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .artifacts import ensure_cpu
+from .spec import CanaryResult, Target, Waiver
+
+_IMAGE_HW = (32, 32)
+_ITERS = 2
+
+#: the fp32 correlation island: the all-pairs GEMM runs fp32 by design
+#: (reference parity, core/raft.py:102-103 analog — see the
+#: RAFTConfig.corr_dtype comment), and its jvp/transpose replicas ride
+#: the same einsum path. Everything else in a bf16 step must be bf16.
+_CORR_ISLAND = Waiver(
+    "H2", "bxc,byc->bxy",
+    "all-pairs correlation GEMM is an intentional fp32 island "
+    "(reference parity; RAFTConfig.corr_dtype docs) — the volume "
+    "STORAGE dtype is corr_dtype's knob, the GEMM itself stays fp32")
+
+
+def _train_batch_avals(jax, batch_size=1):
+    import jax.numpy as jnp
+
+    h, w = _IMAGE_HW
+    return {
+        # uint8 images/valid: the loader's documented low-bandwidth wire
+        # format (data/loader._collate), the dtype the real loop feeds
+        "image1": jax.ShapeDtypeStruct((batch_size, h, w, 3), jnp.uint8),
+        "image2": jax.ShapeDtypeStruct((batch_size, h, w, 3), jnp.uint8),
+        "flow": jax.ShapeDtypeStruct((batch_size, h, w, 2), jnp.float32),
+        "valid": jax.ShapeDtypeStruct((batch_size, h, w), jnp.uint8),
+    }
+
+
+def _build_train_step(model_kwargs):
+    def build():
+        jax = ensure_cpu()
+        from raft_tpu.config import RAFTConfig, TrainConfig
+        from raft_tpu.training.train_step import (create_train_state,
+                                                  make_train_step)
+
+        cfg = RAFTConfig(**model_kwargs)
+        tc = TrainConfig(iters=_ITERS, batch_size=1,
+                         image_size=_IMAGE_HW)
+        rng = jax.random.PRNGKey(0)
+        # avals only — the audit lowers/compiles against shapes, it
+        # never runs the step, so the real (slow) init is skipped
+        state = jax.eval_shape(
+            lambda: create_train_state(cfg, tc, rng,
+                                       image_hw=_IMAGE_HW))
+        return (make_train_step(cfg, tc),
+                (state, _train_batch_avals(jax), rng))
+    return build
+
+
+def _build_serve():
+    def build():
+        jax = ensure_cpu()
+        import jax.numpy as jnp
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.models import RAFT
+
+        cfg = RAFTConfig()
+        model = RAFT(cfg)
+        h, w = _IMAGE_HW
+        img = jax.ShapeDtypeStruct((1, h, w, 3), jnp.float32)
+        variables = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, h, w, 3)),
+                               jnp.zeros((1, h, w, 3)), iters=1))
+
+        def serve(variables, image1, image2):
+            # the RAFTEngine serving fn shape: weights as an ARGUMENT
+            # (serving/engine.py design note) — H6 holds it to that
+            _, flow_up = model.apply(variables, image1, image2,
+                                     iters=_ITERS, test_mode=True)
+            return flow_up
+
+        return serve, (variables, img, img)
+    return build
+
+
+# -- engine canaries ------------------------------------------------------
+
+_ENGINE_WEIGHTS = []   # [(variables, cfg)] — one real init, both canaries
+
+
+def _engine_weights():
+    jax = ensure_cpu()
+    import jax.numpy as jnp
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+
+    if not _ENGINE_WEIGHTS:
+        # the small model: canaries exercise the ENGINE's routing, not
+        # the model, and the small net's real init/compile is ~4x
+        # cheaper on CPU
+        cfg = RAFTConfig(small=True)
+        model = RAFT(cfg)
+        h, w = _IMAGE_HW
+        img = jnp.zeros((1, h, w, 3))
+        variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+        _ENGINE_WEIGHTS.append((variables, cfg))
+    return _ENGINE_WEIGHTS[0]
+
+
+def _build_engine_exact_ragged():
+    def build():
+        ensure_cpu()
+        import numpy as np
+        from raft_tpu.serving.engine import RAFTEngine
+
+        variables, cfg = _engine_weights()
+        eng = RAFTEngine(variables, cfg, iters=_ITERS,
+                         exact_shapes=True)
+        h, w = _IMAGE_HW
+        # 6 frames / batch_size 2 -> full chunks of 2 plus a ragged
+        # tail of 1: exact-shapes mode must batch-fill the tail into
+        # the already-compiled bucket (the PR-2 serving fix), not
+        # compile per distinct tail batch
+        frames = [np.zeros((h, w, 3), np.float32) for _ in range(6)]
+        eng.infer(frames, batch_size=2)
+        texts = tuple(exe.as_text()
+                      for exe in eng._compiled.values() if exe)
+        return CanaryResult(
+            observed_compiles=len(eng._compiled),
+            detail=f"exact_shapes engine, 5 pairs at {h}x{w} in "
+                   "batches of 2 (ragged tail 1)",
+            hlo_texts=texts)
+    return build
+
+
+def _build_engine_bucketed():
+    def build():
+        ensure_cpu()
+        import numpy as np
+        from raft_tpu.serving.engine import RAFTEngine
+
+        variables, cfg = _engine_weights()
+        h, w = _IMAGE_HW
+        eng = RAFTEngine(variables, cfg, iters=_ITERS,
+                         envelope=[(2, h, w)], precompile=True)
+        # in-envelope requests (smaller batch AND smaller spatial) must
+        # route into the precompiled bucket, padding up — never compile
+        eng.infer_batch(np.zeros((1, h - 8, w - 8, 3), np.float32),
+                        np.zeros((1, h - 8, w - 8, 3), np.float32))
+        eng.infer_batch(np.zeros((2, h, w, 3), np.float32),
+                        np.zeros((2, h, w, 3), np.float32))
+        texts = tuple(exe.as_text()
+                      for exe in eng._compiled.values() if exe)
+        return CanaryResult(
+            observed_compiles=len(eng._compiled),
+            detail=f"bucketed engine, envelope [(2,{h},{w})], "
+                   "in-envelope requests at two geometries",
+            hlo_texts=texts)
+    return build
+
+
+def build_targets() -> List[Target]:
+    return [
+        Target(
+            name="train_step",
+            build=_build_train_step({}),
+            donate_argnums=(0,),   # trainer.py jits with donate (0,)
+            notes="basic model, library-default corr backend, fp32"),
+        Target(
+            name="train_step_bf16",
+            # the deployed mixed recipe (BENCH_DEFAULTS winner config):
+            # softsel lookup, bf16 corr volume, bf16 compute
+            build=_build_train_step(dict(mixed_precision=True,
+                                         corr_dtype="bfloat16",
+                                         corr_impl="softsel")),
+            donate_argnums=(0,),
+            compute_dtype="bfloat16",
+            compiled=False,        # H2/H1 are jaxpr-tier; the fp32
+                                   # twin above covers the HLO tier
+            waivers=(_CORR_ISLAND,),
+            notes="mixed-precision step at the r5 winner config"),
+        Target(
+            name="serve",
+            build=_build_serve(),
+            notes="RAFTEngine serving fn shape (weights as argument)"),
+        Target(
+            name="engine_exact_ragged",
+            kind="canary",
+            build=_build_engine_exact_ragged(),
+            expect_compiles=1,     # pinned in tests/test_serving.py
+            notes="ragged-tail batch fill, exact_shapes mode"),
+        Target(
+            name="engine_bucketed",
+            kind="canary",
+            build=_build_engine_bucketed(),
+            expect_compiles=1,
+            notes="envelope routing pads up instead of recompiling"),
+    ]

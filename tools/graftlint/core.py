@@ -35,8 +35,10 @@ from .finding import Finding
 from .jitctx import Analysis
 
 #: directory basenames never entered when walking a directory argument
+#: (graftaudit_fixtures: graftaudit's intentionally-violating audit
+#: fixtures, the artifact-tier analog of graftlint_fixtures)
 _EXCLUDED_DIRS = {"__pycache__", ".git", "graftlint_fixtures",
-                  "node_modules", ".venv"}
+                  "graftaudit_fixtures", "node_modules", ".venv"}
 
 # rule list only — a trailing bare-word justification ("disable=R5
 # process-lifetime by design") must not be swallowed into the rule id
@@ -129,10 +131,157 @@ def lint_file(path: str, rules=None) -> List[Finding]:
     return sorted(kept, key=lambda f: (f.line, f.col, f.rule))
 
 
-def lint_paths(paths: Sequence[str], rules=None) -> List[Finding]:
+# -- parse cache + parallel walk ------------------------------------------
+
+_SIG_CACHE: List[str] = []
+
+
+def _rules_signature() -> str:
+    """Content hash of the whole graftlint package: editing any rule
+    (or this driver) invalidates every cache entry — a cache must never
+    outlive the code that produced it."""
+    if not _SIG_CACHE:
+        import hashlib
+
+        h = hashlib.sha256()
+        pkg = os.path.dirname(os.path.abspath(__file__))
+        for root, dirs, files in os.walk(pkg):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    with open(os.path.join(root, f), "rb") as fh:
+                        h.update(f.encode() + b"\0" + fh.read())
+        _SIG_CACHE.append(h.hexdigest()[:16])
+    return _SIG_CACHE[0]
+
+
+def default_cache_path() -> str:
+    root = os.environ.get("RAFT_GRAFTLINT_CACHE")
+    if root:
+        return root
+    home = os.path.expanduser("~")
+    base = (os.path.join(home, ".cache") if home != "~"
+            else os.path.join(os.sep, "tmp"))
+    return os.path.join(base, "raft_tpu", "graftlint_cache.json")
+
+
+def _load_cache(path: str) -> Dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("sig") == _rules_signature():
+            return data
+    except (OSError, ValueError):
+        pass
+    return {"sig": _rules_signature(), "files": {}}
+
+
+def _save_cache(path: str, cache: Dict) -> None:
+    """Atomic, last-writer-wins: concurrent gate runs (pytest spawns
+    several) may each write; any complete file is a valid cache."""
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(cache, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass     # a cache is an accelerator, never a correctness gate
+
+
+def _rule_ids(rules) -> Optional[List[str]]:
+    return None if rules is None else sorted(m.RULE for m in rules)
+
+
+def _lint_one(job: Tuple[str, Optional[List[str]]]) -> List[Finding]:
+    """Pool worker: rule MODULES don't pickle, ids do."""
+    path, ids = job
+    rules = None
+    if ids is not None:
+        from .rules import ALL_RULES
+        rules = [m for m in ALL_RULES if m.RULE in set(ids)]
+    return lint_file(path, rules=rules)
+
+
+def lint_paths(paths: Sequence[str], rules=None,
+               cache_path: Optional[str] = None,
+               jobs: int = 1) -> List[Finding]:
+    """Lint, optionally with a content-hash parse cache and a process
+    pool over the cache misses. Cache entries key on (path, sha256 of
+    the file bytes, active rule ids) under the package-wide rules
+    signature, so an edit to a file, a rule filter, or the linter
+    itself can never replay stale findings."""
+    import hashlib
+
+    files = collect_files(paths)
+    findings_by_file: Dict[str, List[Finding]] = {}
+    misses: List[str] = []
+    cache = hashes = None
+    ids = _rule_ids(rules)
+    if cache_path:
+        cache = _load_cache(cache_path)
+        hashes = {}
+        rkey = ",".join(ids) if ids is not None else "*"
+        for path in files:
+            try:
+                with open(path, "rb") as f:
+                    digest = hashlib.sha256(f.read()).hexdigest()
+            except OSError:
+                misses.append(path)   # unreadable: E0 via lint_file
+                continue
+            hashes[path] = digest
+            # ABSOLUTE key paths: the default cache is user-global, so
+            # cwd-relative keys from two working directories would
+            # collide and evict each other
+            entry = cache["files"].get(
+                f"{os.path.abspath(path)}|{digest}|{rkey}")
+            if entry is None:
+                misses.append(path)
+            else:
+                findings_by_file[path] = [Finding(**d) for d in entry]
+    else:
+        misses = list(files)
+
+    if jobs > 1 and len(misses) > 1:
+        import multiprocessing
+
+        with multiprocessing.Pool(min(jobs, len(misses))) as pool:
+            linted = pool.map(_lint_one, [(p, ids) for p in misses])
+    else:
+        linted = [lint_file(p, rules=rules) for p in misses]
+    for path, fs in zip(misses, linted):
+        findings_by_file[path] = fs
+
+    if cache is not None:
+        rkey = ",".join(ids) if ids is not None else "*"
+        for path, fs in zip(misses, linted):
+            digest = hashes.get(path)
+            if digest is not None:
+                cache["files"][
+                    f"{os.path.abspath(path)}|{digest}|{rkey}"
+                ] = [f.__dict__ for f in fs]
+        # evict dead weight — without this the shared user-level file
+        # grows forever: entries for a file seen this run under a
+        # superseded digest (any rule filter), and entries whose file
+        # no longer exists at all (deleted/renamed paths; keys are
+        # absolute, so the exists() check is cwd-independent)
+        current = {os.path.abspath(p): d for p, d in hashes.items()}
+        alive: Dict[str, bool] = {}
+        for key in list(cache["files"]):
+            path, digest = key.split("|", 2)[:2]
+            if path in current:
+                if digest != current[path]:
+                    del cache["files"][key]
+            else:
+                if path not in alive:
+                    alive[path] = os.path.exists(path)
+                if not alive[path]:
+                    del cache["files"][key]
+        _save_cache(cache_path, cache)
+
     out: List[Finding] = []
-    for path in collect_files(paths):
-        out.extend(lint_file(path, rules=rules))
+    for path in files:
+        out.extend(findings_by_file.get(path, []))
     return out
 
 
@@ -241,7 +390,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "and exit 0")
     p.add_argument("--rules", metavar="R1,R2,...",
                    help="run only these rule ids")
+    p.add_argument("--jobs", type=int, default=1, metavar="N",
+                   help="parse/lint cache misses across N processes "
+                        "(default 1: in-process)")
+    p.add_argument("--cache", metavar="JSON", default=None,
+                   help="parse-cache file (default: "
+                        "$RAFT_GRAFTLINT_CACHE or "
+                        "~/.cache/raft_tpu/graftlint_cache.json); "
+                        "entries key on file content hash + active "
+                        "rules + a hash of the linter itself, so the "
+                        "cache can never replay stale findings")
+    p.add_argument("--no-cache", action="store_true",
+                   help="lint every file from scratch")
     args = p.parse_args(argv)
+
+    if args.jobs < 1:
+        print("graftlint: --jobs must be >= 1", file=sys.stderr)
+        return 2
+    cache_path = None if args.no_cache \
+        else (args.cache or default_cache_path())
 
     rules = None
     if args.rules:
@@ -262,7 +429,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
-    findings = lint_paths(args.paths, rules=rules)
+    findings = lint_paths(args.paths, rules=rules,
+                          cache_path=cache_path, jobs=args.jobs)
     hard_errors = [f for f in findings if f.rule.startswith("E")]
 
     if args.write_baseline:

@@ -18,83 +18,28 @@ model-source op the fusion came from.  Reference analog: the profiling
 story nvprof/nsys gives the CUDA reference for free via kernel names
 (alt_cuda_corr/correlation_kernel.cu:19 names its own kernels); XLA
 fusions need this mapping step instead.
+
+The parsing itself lives in ``tools/hlo_lib.py`` (shared with
+``tools/graftaudit``, which audits the same artifacts mechanically);
+this module is the human-facing CLI and re-exports the entry points its
+tests pin.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional
 
-_DEF_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*"
-    r"(?P<shape>\([^)]*\)|\S+)\s+fusion\(")
-_META_RE = re.compile(r'op_name="(?P<op>[^"]*)"')
-_CALLS_RE = re.compile(r"calls=%(?P<comp>[\w.\-]+)")
-_KIND_RE = re.compile(r"kind=(?P<kind>k\w+)")
+try:                      # repo-root `python tools/hlo_attr.py` / pytest
+    from tools import hlo_lib
+except ImportError:       # tools/ itself on sys.path
+    import hlo_lib
 
-
-def _pick_module(dump_dir: str) -> Optional[str]:
-    """Largest after-optimizations HLO text in the dump (the main jit)."""
-    cands: List[Tuple[int, str]] = []
-    if not os.path.isdir(dump_dir):
-        return None
-    for fn in os.listdir(dump_dir):
-        if fn.endswith("after_optimizations.txt"):
-            p = os.path.join(dump_dir, fn)
-            cands.append((os.path.getsize(p), p))
-    return max(cands)[1] if cands else None
-
-
-def parse_fusions(path: str) -> Dict[str, dict]:
-    """name -> {shape, kind, op_name, calls, body_lines} for every fusion."""
-    fusions: Dict[str, dict] = {}
-    comp_sizes: Dict[str, int] = {}
-    comp_ops: Dict[str, List[str]] = {}
-    cur_comp = None
-    with open(path) as f:
-        for line in f:
-            m = re.match(r"^(?:ENTRY\s+)?%(?P<comp>[\w.\-]+)\s+\(", line)
-            if m:
-                # ENTRY opens the top-level computation: stop attributing
-                # lines to the previous fused computation
-                cur_comp = None if line.startswith("ENTRY") \
-                    else m.group("comp")
-                if cur_comp is not None:
-                    comp_sizes[cur_comp] = 0
-                    comp_ops[cur_comp] = []
-                continue
-            if line.strip() == "}":
-                cur_comp = None
-            elif cur_comp is not None and line.strip():
-                comp_sizes[cur_comp] += 1
-                bm = _META_RE.search(line)
-                if bm:
-                    comp_ops[cur_comp].append(bm.group("op"))
-            d = _DEF_RE.match(line)
-            if d:
-                meta = _META_RE.search(line)
-                calls = _CALLS_RE.search(line)
-                kind = _KIND_RE.search(line)
-                fusions[d.group("name")] = {
-                    "shape": d.group("shape"),
-                    "kind": kind.group("kind") if kind else "?",
-                    "op_name": meta.group("op") if meta else "(no metadata)",
-                    "calls": calls.group("comp") if calls else None,
-                }
-    for info in fusions.values():
-        info["body_lines"] = comp_sizes.get(info["calls"] or "", 0)
-        if info["op_name"] == "(no metadata)":
-            # fall back to the fused computation's own ops: report the
-            # most frequent op_name in the body
-            ops = comp_ops.get(info["calls"] or "", [])
-            if ops:
-                # max over the list: first-seen wins ties (deterministic)
-                best = max(ops, key=ops.count)
-                info["op_name"] = f"(body) {best}"
-    return fusions
+# pinned legacy surface (tests/test_hlo_attr.py; external callers)
+parse_fusions = hlo_lib.parse_fusions
+_pick_module = hlo_lib.pick_module
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,336 @@
+"""HLO-text parsing library: fusions, op traffic, aliases, constants.
+
+Grew out of ``tools/hlo_attr.py`` (which keeps its CLI and re-exports
+the parsing entry points from here): the fusion -> ``metadata.op_name``
+attribution it built for trace work is exactly what a compiled-artifact
+audit needs as a *library* — ``tools/graftaudit`` consumes this module
+for its H4 (donation honored), H5 (per-op-name traffic budgets), and
+H6 (constant-folding traps) rules, over HLO text obtained either from
+``jax.stages.Compiled.as_text()`` or an ``--xla_dump_to`` directory.
+
+Everything here is pure text parsing over XLA's HLO dump format — no
+jax import, so it loads in pure-stdlib contexts (pytest collection,
+the graftlint process) for free.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# Two dialects of the same format must parse: ``Compiled.as_text()``
+# prefixes every name with ``%`` and computation headers carry a typed
+# signature (``%comp (a: f32[]) -> f32[] {``); ``--xla_dump_to`` files
+# drop both (``comp {``, ``dot.4 = ...``). ``%`` is optional everywhere
+# and the header signature is optional in _COMP_RE.
+_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<shape>\([^)]*\)|\S+)\s+fusion\(")
+_META_RE = re.compile(r'op_name="(?P<op>[^"]*)"')
+_CALLS_RE = re.compile(r"calls=%?(?P<comp>[\w.\-]+)")
+_KIND_RE = re.compile(r"kind=(?P<kind>k\w+)")
+# any instruction def: `%name = <shape> <opcode>(`; shape is either a
+# tuple `(f32[2]{0}, ...)` or a single token
+_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<shape>\([^)]*\)|\S+)\s+(?P<opcode>[\w\-]+)\(")
+# a computation header is a top-of-line (never indented — instructions
+# are) name followed by an optional typed signature (which may carry
+# layout braces, `f32[8,64]{1,0}`), ending in the opening brace
+_COMP_RE = re.compile(
+    r"^(?:ENTRY\s+)?%?(?P<comp>[\w.\-]+)\s*(?:\(.*)?\{\s*$")
+# computations referenced as fusion/call/reduce bodies — their inner ops
+# are accounted for at the call site, not individually. while/conditional
+# regions (body=/condition=/branch_computations=) are deliberately NOT
+# here: control flow executes those ops directly, each line carrying its
+# own op_name, and the scan-body band graftaudit budgets lives there.
+_SUBCOMP_RE = re.compile(r"(?:calls|to_apply)=%?([\w.\-]+)")
+_CCTARGET_RE = re.compile(r'custom_call_target="(?P<t>[^"]+)"')
+
+#: bytes per element for the HLO dtype prefixes this repo's programs use
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+_SHAPE_TOKEN_RE = re.compile(
+    r"\b(?P<dt>" + "|".join(sorted(DTYPE_BYTES, key=len, reverse=True))
+    + r")\[(?P<dims>[\d,]*)\]")
+
+
+def shape_bytes(shape_str: str) -> int:
+    """Total bytes of every ``dtype[dims]`` token in ``shape_str`` —
+    handles single shapes, tuple shapes, and whole instruction lines
+    (result + inline operand shapes)."""
+    total = 0
+    for m in _SHAPE_TOKEN_RE.finditer(shape_str):
+        n = DTYPE_BYTES[m.group("dt")]
+        dims = m.group("dims")
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n
+    return total
+
+
+def pick_module(dump_dir: str) -> Optional[str]:
+    """Largest after-optimizations HLO text in the dump (the main jit)."""
+    cands: List[Tuple[int, str]] = []
+    if not os.path.isdir(dump_dir):
+        return None
+    for fn in os.listdir(dump_dir):
+        if fn.endswith("after_optimizations.txt"):
+            p = os.path.join(dump_dir, fn)
+            cands.append((os.path.getsize(p), p))
+    return max(cands)[1] if cands else None
+
+
+def parse_fusions_text(text) -> Dict[str, dict]:
+    """name -> {shape, kind, op_name, calls, body_lines} for every
+    fusion. ``text`` is a string or any iterable of lines — real
+    after-optimizations dumps run to hundreds of MB, so the file path
+    (:func:`parse_fusions`) streams instead of slurping."""
+    fusions: Dict[str, dict] = {}
+    comp_sizes: Dict[str, int] = {}
+    comp_ops: Dict[str, List[str]] = {}
+    cur_comp = None
+    lines = text.splitlines(keepends=True) if isinstance(text, str) \
+        else text
+    for line in lines:
+        m = _COMP_RE.match(line)
+        if m:
+            # ENTRY opens the top-level computation: stop attributing
+            # lines to the previous fused computation
+            cur_comp = None if line.startswith("ENTRY") \
+                else m.group("comp")
+            if cur_comp is not None:
+                comp_sizes[cur_comp] = 0
+                comp_ops[cur_comp] = []
+            continue
+        if line.strip() == "}":
+            cur_comp = None
+        elif cur_comp is not None and line.strip():
+            comp_sizes[cur_comp] += 1
+            bm = _META_RE.search(line)
+            if bm:
+                comp_ops[cur_comp].append(bm.group("op"))
+        d = _DEF_RE.match(line)
+        if d:
+            meta = _META_RE.search(line)
+            calls = _CALLS_RE.search(line)
+            kind = _KIND_RE.search(line)
+            fusions[d.group("name")] = {
+                "shape": d.group("shape"),
+                "kind": kind.group("kind") if kind else "?",
+                "op_name": meta.group("op") if meta else "(no metadata)",
+                "calls": calls.group("comp") if calls else None,
+            }
+    for info in fusions.values():
+        info["body_lines"] = comp_sizes.get(info["calls"] or "", 0)
+        if info["op_name"] == "(no metadata)":
+            # fall back to the fused computation's own ops: report the
+            # most frequent op_name in the body
+            ops = comp_ops.get(info["calls"] or "", [])
+            if ops:
+                # max over the list: first-seen wins ties (deterministic)
+                best = max(ops, key=ops.count)
+                info["op_name"] = f"(body) {best}"
+    return fusions
+
+
+def parse_fusions(path: str) -> Dict[str, dict]:
+    with open(path) as f:
+        return parse_fusions_text(f)   # streamed, not slurped
+
+
+# -- audit-tier parsers (graftaudit consumers) ----------------------------
+
+#: opcodes that move no bytes of their own (aliases, plumbing), whose
+#: bytes are accounted inside their region (while/conditional carry the
+#: whole loop state tuple on their def line — the region's ops already
+#: bill those bytes), or that materialize nothing (iota, constants —
+#: constants are H6's concern, not traffic)
+_FREE_OPCODES = {"parameter", "constant", "tuple", "get-tuple-element",
+                 "bitcast", "after-all", "iota", "while", "conditional"}
+
+
+def _operand_names(line: str, opcode: str) -> List[str]:
+    """Bare operand names from an instruction's call parens (the dump
+    dialect: ``fusion(dot.4, Arg_0.1)`` — no inline shapes)."""
+    i = line.find(opcode + "(")
+    if i < 0:
+        return []
+    seg = line[i + len(opcode) + 1:]
+    depth = 0
+    for j, ch in enumerate(seg):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            if depth == 0:
+                seg = seg[:j]
+                break
+            depth -= 1
+    return [t for t in (p.strip().lstrip("%") for p in seg.split(","))
+            if re.fullmatch(r"[\w.\-]+", t)]
+
+
+def iter_op_traffic(text: str) -> Iterable[dict]:
+    """One record per byte-moving instruction OUTSIDE called
+    sub-computations: ``{name, opcode, op_name, bytes, custom_target}``.
+
+    ``bytes`` sums the result plus every operand shape — a
+    deterministic traffic *estimate* in the spirit of XLA's cost
+    analysis, attributable per-op via ``metadata.op_name`` (which the
+    aggregate ``Compiled.cost_analysis()`` number is not). In the
+    ``Compiled.as_text()`` dialect operand shapes are inline on the
+    line; ``--xla_dump_to`` files print bare operand names, so those
+    are resolved against the module's defs — both dialects price the
+    same instruction the same. Instructions inside fusion/reduce bodies
+    are skipped: the fusion def line already carries the fused region's
+    operand/result shapes, so counting body lines would double-bill
+    every fused byte. While/conditional bodies are *not* skipped —
+    control-flow regions execute their ops directly and each line
+    carries its own op_name (the scan-body band lives there)."""
+    sub: Set[str] = set(m for m in _SUBCOMP_RE.findall(text))
+    lines = text.splitlines()
+    # def map for the bare-operand dialect: name -> result-shape bytes
+    def_bytes: Dict[str, int] = {}
+    for line in lines:
+        d = _OP_RE.match(line)
+        if d:
+            def_bytes[d.group("name")] = shape_bytes(d.group("shape"))
+    cur_comp = None
+    for line in lines:
+        m = _COMP_RE.match(line)
+        if m:
+            cur_comp = None if line.startswith("ENTRY") else m.group("comp")
+            continue
+        if line.strip() == "}":
+            cur_comp = None
+            continue
+        if cur_comp in sub:
+            continue
+        d = _OP_RE.match(line)
+        if not d or d.group("opcode") in _FREE_OPCODES:
+            continue
+        total = shape_bytes(line)
+        result = shape_bytes(d.group("shape"))
+        if total == result:
+            # no inline operand shapes (dump dialect): resolve names
+            total += sum(def_bytes.get(n, 0) for n in
+                         _operand_names(line, d.group("opcode")))
+        meta = _META_RE.search(line)
+        cct = _CCTARGET_RE.search(line)
+        yield {
+            "name": d.group("name"),
+            "opcode": d.group("opcode"),
+            "op_name": meta.group("op") if meta else "",
+            "bytes": total,
+            "custom_target": cct.group("t") if cct else "",
+        }
+
+
+def band_traffic(text: str, match: str) -> Tuple[int, int]:
+    """(total bytes, op count) over instructions whose ``op_name``
+    contains ``match`` (empty string matches every instruction)."""
+    total = ops = 0
+    for rec in iter_op_traffic(text):
+        if match in rec["op_name"]:
+            total += rec["bytes"]
+            ops += 1
+    return total, ops
+
+
+def parse_aliased_params(text: str) -> Set[int]:
+    """Param indices the optimized module's ``input_output_alias`` map
+    covers — XLA's ground truth for which donations were HONORED."""
+    hdr = text.split("\n", 1)[0]
+    i = hdr.find("input_output_alias={")
+    if i < 0:
+        return set()
+    seg = hdr[i + len("input_output_alias={"):]
+    # entries look like `{out_idx}: (param, {path}, may-alias)`; the
+    # segment ends at the first `}` that closes the map — but entries
+    # nest one brace level, so cut at the next header key instead
+    end = seg.find("}, ")
+    while end >= 0 and seg[:end].count("{") != seg[:end].count("}"):
+        end = seg.find("}, ", end + 1)
+    seg = seg if end < 0 else seg[:end]
+    return {int(p) for p in re.findall(r"\}:\s*\((\d+)\s*,", seg)}
+
+
+def parse_entry_param_shapes(text: str) -> List[str]:
+    """Entry parameter shapes, by param index, from the module header's
+    ``entry_computation_layout={(...)->...}``. Split on top-level commas
+    only — dims and layouts carry commas of their own
+    (``f32[4,4]{1,0}``), and tuple params nest parens."""
+    hdr = text.split("\n", 1)[0]
+    anchor = "entry_computation_layout={("
+    start = hdr.find(anchor)
+    if start < 0:
+        return []
+    out: List[str] = []
+    depth = 0
+    cur: List[str] = []
+
+    def flush():
+        s = "".join(cur).strip()
+        if s:
+            out.append(s)
+        cur.clear()
+
+    for ch in hdr[start + len(anchor):]:
+        if ch == ")" and depth == 0:       # closes the params list
+            break
+        if ch in "[{(":
+            depth += 1
+        elif ch in "]})":
+            depth -= 1
+        if ch == "," and depth == 0:
+            flush()
+        else:
+            cur.append(ch)
+    flush()
+    return out
+
+
+def find_large_constants(text: str, min_bytes: int) -> List[dict]:
+    """Materialized literals at least ``min_bytes`` big, anywhere in the
+    module: ``{name, shape, bytes, op_name}``. Byte size comes from the
+    declared result shape, so elided literals (``constant({...})``) are
+    still sized correctly."""
+    out: List[dict] = []
+    for line in text.splitlines():
+        d = _OP_RE.match(line)
+        if not d or d.group("opcode") != "constant":
+            continue
+        size = shape_bytes(d.group("shape"))
+        if size >= min_bytes:
+            meta = _META_RE.search(line)
+            out.append({
+                "name": d.group("name"),
+                "shape": d.group("shape"),
+                "bytes": size,
+                "op_name": meta.group("op") if meta else "",
+            })
+    return out
+
+
+def find_host_ops(text: str) -> List[dict]:
+    """Instructions that cross the host boundary inside the module:
+    infeed/outfeed/send/recv and custom-calls whose target names a host
+    callback. Returns ``{name, opcode, detail, op_name}``."""
+    out: List[dict] = []
+    for rec in iter_op_traffic(text):
+        op = rec["opcode"]
+        if op in ("infeed", "outfeed", "send", "recv",
+                  "send-done", "recv-done"):
+            out.append({"name": rec["name"], "opcode": op,
+                        "detail": op, "op_name": rec["op_name"]})
+        elif op == "custom-call":
+            tgt = rec["custom_target"]
+            if re.search(r"callback|CallbackTo|host", tgt, re.IGNORECASE):
+                out.append({"name": rec["name"], "opcode": op,
+                            "detail": tgt, "op_name": rec["op_name"]})
+    return out

@@ -89,6 +89,25 @@ bench_cfg g_gruxla 2400 --batches 8 6 $R5_WINNER --gru-impl xla
 bench_cfg g_grufused 2700 --batches 8 6 $R5_WINNER --gru-impl fused
 commit_msmt "r6 gru_impl A/B ladder rows" ONCHIP_r06.log
 
+# ---- HLO capture for graftaudit budget re-anchoring -------------------
+# tools/graftaudit/budgets.json pins the H5 scan-body/whole-step bands
+# with CPU-anchored byte counts; this dump gives the next PR a real TPU
+# module to re-anchor them from (tools/hlo_lib.pick_module +
+# band_traffic read an --xla_dump_to directory directly). Kept OUT of
+# the A/B rungs: dumping is compile-time-only but the measurement pair
+# stays env-identical on principle. Compile-only cost: 2 steps.
+HLO_DUMP=${RAFT_R6_HLO_DUMP:-/root/.cache/raft_tpu/r6_hlo_dump}
+mkdir -p "$HLO_DUMP"
+# shellcheck disable=SC2086
+step hlo_dump_r6 1500 env \
+    XLA_FLAGS="--xla_dump_to=$HLO_DUMP --xla_dump_hlo_as_text" \
+    python bench.py --steps 2 --batches 8 $R5_WINNER --gru-impl xla
+if [ -e "$MARK/hlo_dump_r6" ]; then
+    log "hlo dump module: $(python -c \
+        "from tools.hlo_lib import pick_module as p; \
+print(p('$HLO_DUMP'))" 2>/dev/null || echo unreadable)"
+fi
+
 # ---- secondary: fused at the b10 memory edge (the Pallas epilogues
 # drop gate intermediates from the scan's saved-residual stack, so the
 # fused path may fit a batch the xla path OOMs at) -----------------------
