@@ -1,0 +1,194 @@
+"""Synthetic ragged-traffic drill for the serving front-end.
+
+Drives ``MicroBatchScheduler`` + a warm-start ``RAFTEngine`` with
+mixed-shape traffic from concurrent submitter threads (plus optional
+per-stream video sessions) and prints ONE JSON summary line — the
+serving-side counterpart of bench.py's one-line contract, and the
+harness the tier-1 acceptance drill (tests/test_scheduler.py) runs at
+tiny shapes. The request mix is deliberately ragged: per-shape totals
+that don't divide the bucket batch leave a tail the scheduler must
+batch-fill into the SAME executables the full micro-batches used.
+
+Run on the real chip (cvt2trt-ish shapes):
+    python -m raft_tpu.cli.serve_bench --shapes 440x1024,368x496 \\
+        --requests 48 --submitters 2 --bucket-batch 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+
+
+def _ceil8(x: int) -> int:
+    return -(-x // 8) * 8
+
+
+def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
+              bucket_batch=4, iters=2, sessions=0, session_frames=4,
+              deadline_s=None, max_queue=64, gather_window_s=0.005,
+              metrics_path=None, seed=0, engine=None):
+    """The drill as a library call (tests reuse it, and may pass a
+    prebuilt warm-start ``engine`` to share compiles across drills).
+    Returns the summary dict the CLI prints."""
+    import numpy as np
+
+    from raft_tpu.serving.engine import RAFTEngine
+    from raft_tpu.serving.scheduler import (BackpressureError,
+                                            DeadlineExceeded,
+                                            MicroBatchScheduler)
+    from raft_tpu.serving.session import VideoSession
+
+    if engine is None:
+        # one documented bucket per distinct ÷8-padded request shape
+        envelope = sorted({(bucket_batch, _ceil8(h), _ceil8(w))
+                           for h, w in shapes})
+        engine = RAFTEngine(variables, cfg, iters=iters,
+                            envelope=envelope, precompile=True,
+                            warm_start=True)
+    documented = len(engine._compiled)
+    sched = MicroBatchScheduler(engine, max_queue=max_queue,
+                                max_batch=bucket_batch,
+                                gather_window_s=gather_window_s,
+                                metrics_path=metrics_path)
+    futures = [[] for _ in range(submitters)]
+    shed = [0] * submitters
+    session_stats = {"pairs": 0, "warm": 0, "errors": 0}
+
+    def submit_loop(sid):
+        rng = np.random.RandomState(seed + sid)
+        per = requests // submitters + (1 if sid < requests % submitters
+                                        else 0)
+        for k in range(per):
+            h, w = shapes[(sid + k) % len(shapes)]
+            i1 = rng.rand(h, w, 3).astype(np.float32) * 255
+            i2 = rng.rand(h, w, 3).astype(np.float32) * 255
+            try:
+                futures[sid].append(
+                    sched.submit(i1, i2, deadline_s=deadline_s))
+            except BackpressureError:
+                shed[sid] += 1
+
+    def session_loop(sid):
+        rng = np.random.RandomState(seed + 1000 + sid)
+        h, w = shapes[sid % len(shapes)]
+        sess = VideoSession(sched, deadline_s=deadline_s)
+        futs = [sess.submit_frame(rng.rand(h, w, 3).astype(np.float32)
+                                  * 255)
+                for _ in range(session_frames + 1)]
+        for f in futs:
+            if f is None:
+                continue
+            try:
+                f.result(timeout=600)
+                session_stats["pairs"] += 1
+            except Exception:
+                session_stats["errors"] += 1
+        session_stats["warm"] += sess.warm_submits
+
+    threads = ([threading.Thread(target=submit_loop, args=(s,))
+                for s in range(submitters)]
+               + [threading.Thread(target=session_loop, args=(s,))
+                  for s in range(sessions)])
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sched.close(drain=True)          # finishes every accepted request
+    wall = time.perf_counter() - t0
+
+    served = deadline_missed = errors = 0
+    for fl in futures:
+        for fut in fl:
+            try:
+                fut.result(timeout=0)  # close() drained: all settled
+                served += 1
+            except DeadlineExceeded:
+                deadline_missed += 1
+            except Exception:
+                errors += 1
+    rec = sched.metrics.snapshot(executables=len(engine._compiled))
+    total_served = served + session_stats["pairs"]
+    occ = rec["occupancy"]
+    return {
+        "submitted": rec["submitted"],
+        "served": served,
+        "shed": sum(shed),
+        "deadline_missed": deadline_missed,
+        "errors": errors + session_stats["errors"],
+        "abandoned_inflight": rec["abandoned_inflight"],
+        "dispatches": rec["dispatches"],
+        "executables": len(engine._compiled),
+        "documented_buckets": documented,
+        "mean_occupancy": occ["mean"],
+        "baseline_occupancy": occ["one_per_dispatch_baseline"],
+        "session_pairs": session_stats["pairs"],
+        "warm_submits": session_stats["warm"],
+        "p50_ms": rec["latency"]["p50_ms"],
+        "p99_ms": rec["latency"]["p99_ms"],
+        "wall_s": round(wall, 3),
+        "pairs_per_s": round(total_served / wall, 2) if wall else 0.0,
+    }
+
+
+def main(argv=None):
+    from raft_tpu.utils.platform import setup_cli
+
+    setup_cli()
+    p = argparse.ArgumentParser(
+        description="serving front-end ragged-traffic drill")
+    p.add_argument("--shapes", default="64x64,48x48",
+                   help="comma list of HxW request shapes (the mixed "
+                        "traffic); one bucket per distinct ÷8 shape")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--submitters", type=int, default=2)
+    p.add_argument("--bucket-batch", type=int, default=4,
+                   help="bucket batch dim = coalescing ceiling")
+    p.add_argument("--sessions", type=int, default=0,
+                   help="concurrent warm-start video sessions")
+    p.add_argument("--session-frames", type=int, default=4)
+    p.add_argument("--deadline-ms", type=float, default=0,
+                   help="per-request deadline (0: none)")
+    p.add_argument("--queue", type=int, default=64)
+    p.add_argument("--gather-ms", type=float, default=5.0)
+    p.add_argument("--iters", type=int, default=20,
+                   help="refinement iterations (export bakes 20)")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--log-dir", default=None,
+                   help="append the metrics snapshot to "
+                        "<log-dir>/metrics.jsonl")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+
+    shapes = [tuple(int(v) for v in s.split("x"))
+              for s in args.shapes.split(",")]
+    cfg = RAFTConfig(small=args.small)
+    model = RAFT(cfg)
+    # params are shape-independent: init tiny (infer_bench lesson)
+    tiny = jnp.zeros((1, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), tiny, tiny, iters=1)
+    metrics_path = (os.path.join(args.log_dir, "metrics.jsonl")
+                    if args.log_dir else None)
+    summary = run_drill(
+        variables, cfg, shapes=shapes, requests=args.requests,
+        submitters=args.submitters, bucket_batch=args.bucket_batch,
+        iters=args.iters, sessions=args.sessions,
+        session_frames=args.session_frames,
+        deadline_s=args.deadline_ms / 1e3 if args.deadline_ms else None,
+        max_queue=args.queue, gather_window_s=args.gather_ms / 1e3,
+        metrics_path=metrics_path, seed=args.seed)
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -169,7 +169,14 @@ def _supervise(args, argv) -> int:
     if "--resume" not in child:
         child.append("--resume")
     sup = Supervisor([sys.executable, "-m", "raft_tpu.cli.train", *child],
-                     max_restarts=args.max_restarts, ckpt_dir=stage_dir)
+                     max_restarts=args.max_restarts, ckpt_dir=stage_dir,
+                     # restart events land in the SAME metrics.jsonl the
+                     # trainer's Logger appends to (trainer.py builds it
+                     # under <log_dir>/<name>) — one file, one dashboard
+                     # tail for curves and restarts both
+                     metrics_path=os.path.join(train_cfg.log_dir,
+                                               train_cfg.name,
+                                               "metrics.jsonl"))
     return sup.run()
 
 

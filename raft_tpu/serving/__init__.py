@@ -5,8 +5,21 @@ wrapper (test_trt.py:102-161, cvt2trt.sh, raft_trt.py). Here the same roles
 are: AOT compilation (``jax.jit(...).lower().compile()``) over a shape-bucket
 envelope (``engine.py``), portable StableHLO serialization (``export.py``),
 and the video/batch helpers (``video.py`` = raft_trt_utils.py analog).
+
+Above the engine sits the serving front-end the reference never had:
+an async micro-batching scheduler with deadlines and backpressure
+(``scheduler.py``), per-stream warm-start video sessions
+(``session.py``), and the serving metrics surface (``metrics.py``).
 """
 
 from raft_tpu.serving.engine import SHAPE_ENVELOPE_LINUX, RAFTEngine
+from raft_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from raft_tpu.serving.scheduler import (BackpressureError, DeadlineExceeded,
+                                        MicroBatchScheduler, SchedulerClosed,
+                                        ServeResult)
+from raft_tpu.serving.session import VideoSession
 
-__all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX"]
+__all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX", "MicroBatchScheduler",
+           "BackpressureError", "DeadlineExceeded", "SchedulerClosed",
+           "ServeResult", "VideoSession", "ServingMetrics",
+           "LatencyHistogram"]

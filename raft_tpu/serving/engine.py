@@ -15,6 +15,7 @@ iteration count is baked at 20 (test_trt.py:124, ITERS_EXPORT).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -41,7 +42,7 @@ class RAFTEngine:
                  iters: int = ITERS_EXPORT,
                  envelope: Sequence[Tuple[int, int, int]] = (),
                  precompile: bool = True, mesh=None,
-                 exact_shapes: bool = False):
+                 exact_shapes: bool = False, warm_start: bool = False):
         """``mesh``: optional ``jax.sharding.Mesh`` (data × spatial axes,
         `parallel.mesh.make_mesh`) — buckets then compile as SPMD
         programs with batch sharded over 'data' and image height over
@@ -60,11 +61,29 @@ class RAFTEngine:
         already-compiled same-spatial bucket: batch fill is per-sample
         neutral, and without it every ragged sliding-window tail
         (``infer``'s last chunk) would compile its own executable.
+
+        ``warm_start``: buckets compile with a low-res ``flow_init``
+        input and a ``(flow_low, flow_up)`` output so per-stream video
+        sessions can carry the previous pair's flow into the next
+        refinement start (the Sintel warm-start path,
+        evaluation/evaluate.py, lifted into serving). A zero
+        ``flow_init`` row IS a cold start (``coords1 + 0``), so warm
+        sessions and one-shot requests batch into the SAME executable —
+        still one per bucket. Off by default: the engine-direct
+        single-output contract (the exported-``flowup`` analog) is
+        unchanged.
         """
         self.config = config
         self.iters = iters
         self.mesh = mesh
         self.exact_shapes = exact_shapes
+        self.warm_start = warm_start
+        #: guards ``_compiled`` and the weight-tree swap so a live
+        #: ``update_weights`` under concurrent dispatch can't mix old
+        #: and new weights within one dispatch (each ``infer_batch``
+        #: snapshots the tree ONCE under this lock), and two dispatch
+        #: threads can't race a compile-on-miss insert
+        self._lock = threading.RLock()
         if mesh is not None:
             from raft_tpu.parallel.mesh import (batch_sharding, replicated,
                                                 validate_spatial_extent)
@@ -77,19 +96,32 @@ class RAFTEngine:
             self.variables = jax.device_put(variables)
         model = RAFT(config)
 
-        def serve(variables, image1, image2):
-            # single-output serving fn, the exported-``flowup`` analog.
-            # Weights ride as an ARGUMENT, not a baked closure: the
-            # compiled bucket (and its persistent-cache entry) is then
-            # keyed by shapes only — swapping a checkpoint reuses every
-            # executable instead of recompiling the envelope, and the
-            # lowered program stays KB-sized rather than carrying ~21 MB
-            # of weight constants per bucket upload. (The StableHLO
-            # EXPORT still bakes weights — a single portable artifact is
-            # the point there, as with the reference's ONNX file.)
-            _, flow_up = model.apply(variables, image1, image2,
-                                     iters=iters, test_mode=True)
-            return flow_up
+        if warm_start:
+            def serve(variables, image1, image2, flow_init):
+                # warm-start serving fn: ``flow_init`` rides at 1/8
+                # resolution and a zero row is exactly a cold start, so
+                # the scheduler can coalesce warm sessions and one-shot
+                # requests into one bucket executable. Returns flow_low
+                # too — the state a session feeds back.
+                flow_low, flow_up = model.apply(
+                    variables, image1, image2, iters=iters,
+                    flow_init=flow_init, test_mode=True)
+                return flow_low, flow_up
+        else:
+            def serve(variables, image1, image2):
+                # single-output serving fn, the exported-``flowup``
+                # analog. Weights ride as an ARGUMENT, not a baked
+                # closure: the compiled bucket (and its persistent-cache
+                # entry) is then keyed by shapes only — swapping a
+                # checkpoint reuses every executable instead of
+                # recompiling the envelope, and the lowered program
+                # stays KB-sized rather than carrying ~21 MB of weight
+                # constants per bucket upload. (The StableHLO EXPORT
+                # still bakes weights — a single portable artifact is
+                # the point there, as with the reference's ONNX file.)
+                _, flow_up = model.apply(variables, image1, image2,
+                                         iters=iters, test_mode=True)
+                return flow_up
 
         self._fn = jax.jit(serve)
         self._compiled: Dict[Tuple[int, int, int], jax.stages.Compiled] = {}
@@ -131,9 +163,14 @@ class RAFTEngine:
                     for k in old.keys() & new.keys() if old[k] != new[k]]
             raise ValueError(
                 "checkpoint structure mismatch: " + "; ".join(diff[:5]))
-        self.variables = (jax.device_put(variables, self._rep)
-                          if self.mesh is not None
-                          else jax.device_put(variables))
+        staged = (jax.device_put(variables, self._rep)
+                  if self.mesh is not None
+                  else jax.device_put(variables))
+        # the swap itself is a single reference assignment under the
+        # dispatch lock: an in-flight infer_batch already holds its own
+        # snapshot, the next one sees the new tree whole
+        with self._lock:
+            self.variables = staged
 
     # -- shape routing ------------------------------------------------------
 
@@ -146,29 +183,53 @@ class RAFTEngine:
         spatial = self.mesh.shape.get("spatial", 1)
         return data, 8 * spatial
 
-    def _get_executable(self, shape: Tuple[int, int, int]):
-        exe = self._compiled.get(shape)
-        if exe is None:
-            b, h, w = shape
-            if self.mesh is not None:
-                self._validate_extent(h, self.mesh)
-                # compile-on-miss buckets are pre-rounded in infer_batch,
-                # but user-supplied envelope buckets reach here unrounded;
-                # an uneven bucket compiles fine and only fails later at
-                # device_put with an opaque uneven-sharding ValueError
-                bg, hg = self._mesh_grain()
-                if b % bg or h % hg:
-                    raise ValueError(
-                        f"bucket {shape} is not mesh-divisible: batch must "
-                        f"be a multiple of data={bg} and height a "
-                        f"multiple of 8*spatial={hg}")
-                spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32,
-                                            sharding=self._in_shard)
-            else:
-                spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
-            exe = self._fn.lower(self.variables, spec, spec).compile()
-            self._compiled[shape] = exe
-        return exe
+    def _get_executable(self, shape: Tuple[int, int, int], variables=None):
+        with self._lock:
+            if variables is None:
+                variables = self.variables
+            exe = self._compiled.get(shape)
+        if exe is not None:
+            return exe
+        b, h, w = shape
+        if self.mesh is not None:
+            self._validate_extent(h, self.mesh)
+            # compile-on-miss buckets are pre-rounded in infer_batch,
+            # but user-supplied envelope buckets reach here unrounded;
+            # an uneven bucket compiles fine and only fails later at
+            # device_put with an opaque uneven-sharding ValueError
+            bg, hg = self._mesh_grain()
+            if b % bg or h % hg:
+                raise ValueError(
+                    f"bucket {shape} is not mesh-divisible: batch must "
+                    f"be a multiple of data={bg} and height a "
+                    f"multiple of 8*spatial={hg}")
+            shard = self._in_shard
+        else:
+            shard = None
+        spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32,
+                                    sharding=shard)
+        args = [variables, spec, spec]
+        if self.warm_start:
+            # flow_init rides at 1/8 res; h % (8*spatial) == 0 under a
+            # mesh makes h//8 divide the spatial axis, so the same
+            # batch+spatial sharding applies
+            args.append(jax.ShapeDtypeStruct(
+                (b, h // 8, w // 8, 2), jnp.float32, sharding=shard))
+        # compile OUTSIDE the lock: minutes on real hardware, and the
+        # lock must stay cheap (weight swaps and already-compiled
+        # dispatches would stall behind it). The executable is keyed by
+        # avals only, so compiling against a stale snapshot is fine;
+        # racing threads at worst duplicate one compile and the first
+        # insert wins.
+        exe = self._fn.lower(*args).compile()
+        with self._lock:
+            # first compile wins a race; a precompile=False placeholder
+            # (None) is filled, not treated as an existing executable
+            cur = self._compiled.get(shape)
+            if cur is None:
+                self._compiled[shape] = exe
+                cur = exe
+        return cur
 
     def _select_bucket(self, b: int, h: int, w: int
                        ) -> Optional[Tuple[int, int, int]]:
@@ -194,12 +255,77 @@ class RAFTEngine:
             return None
         return min(fits, key=lambda s: s[0] * s[1] * s[2])
 
+    def _route(self, b: int, hp: int, wp: int) -> Tuple[int, int, int]:
+        """Bucket a ÷8-padded ``(b, hp, wp)`` request will use: the
+        smallest compiled fit, else the (mesh-rounded) compile-on-miss
+        bucket — the single source infer_batch and the scheduler's
+        routing questions share."""
+        with self._lock:
+            bucket = self._select_bucket(b, hp, wp)
+        if bucket is None:
+            bb, bh = b, hp
+            if self.mesh is not None:
+                # batch rides the 'data' axis, height the 'spatial' axis
+                # — round the ad-hoc bucket up so every device gets
+                # whole examples and whole feature rows (the bucket's
+                # zero-fill + output crop absorbs the padding either
+                # way)
+                bg, hg = self._mesh_grain()
+                bb = -(-b // bg) * bg
+                bh = -(-hp // hg) * hg
+            bucket = (bb, bh, wp)
+        return bucket
+
+    def _padded(self, h: int, w: int) -> Tuple[int, int]:
+        left, right, top, bottom = pad_amounts(h, w)
+        return h + top + bottom, w + left + right
+
+    def route_bucket(self, b: int, h: int, w: int) -> Tuple[int, int, int]:
+        """The bucket ``infer_batch`` would use for a raw ``(b, h, w)``
+        request — compiles nothing."""
+        hp, wp = self._padded(h, w)
+        return self._route(b, hp, wp)
+
+    def bucket_capacity(self, h: int, w: int) -> Optional[int]:
+        """Largest batch an already-compiled bucket can carry for an
+        ``(h, w)`` request, or None when no compiled bucket spatially
+        fits — the scheduler's cross-caller coalescing ceiling."""
+        hp, wp = self._padded(h, w)
+        with self._lock:
+            if self.exact_shapes:
+                fits = [s[0] for s in self._compiled
+                        if s[1] == hp and s[2] == wp]
+            else:
+                fits = [s[0] for s in self._compiled
+                        if s[1] >= hp and s[2] >= wp]
+        return max(fits) if fits else None
+
+    def ensure_bucket(self, batch: int, h: int, w: int
+                      ) -> Tuple[int, int, int]:
+        """Compile (if missing) and return the bucket that serves a
+        ``(batch, h, w)`` request. The scheduler pre-warms ONE bucket
+        per distinct spatial shape at its max micro-batch so every
+        later fill count batch-fills into it instead of compiling per
+        distinct micro-batch size (the PR-2 ragged-tail lesson, one
+        layer up)."""
+        hp, wp = self._padded(h, w)
+        bucket = self._route(batch, hp, wp)
+        self._get_executable(bucket)
+        return bucket
+
     # -- inference ----------------------------------------------------------
 
-    def infer_batch(self, image1, image2) -> np.ndarray:
+    def infer_batch(self, image1, image2, flow_init=None,
+                    return_low: bool = False):
         """(B,H,W,3) float [0,255] -> (B,H,W,2) flow. Routes to a bucket,
         padding up (raft_trt_utils.pad_images analog); falls back to an
         exact-shape jit specialization outside the envelope.
+
+        ``flow_init`` (warm_start engines only): per-sample 1/8-res warm
+        start, shape ``(B, hp//8, wp//8, 2)`` in the ÷8-padded frame
+        space — exactly the ``flow_low`` a previous same-shape call
+        returned (forward-interpolated by the session layer).
+        ``return_low=True`` additionally returns that ``flow_low``.
 
         Accuracy note: bucket fill beyond the ÷8 pad shifts the encoders'
         instance-norm statistics, which couple every output pixel to the
@@ -208,39 +334,59 @@ class RAFTEngine:
         (tests/test_evaluation.py bucketing-delta test). TensorRT's
         dynamic shapes don't pay this; exact-shape compile (an envelope
         bucket per deployed shape) avoids it here."""
+        if (flow_init is not None or return_low) and not self.warm_start:
+            raise ValueError(
+                "flow_init/return_low need a warm_start=True engine — "
+                "this engine compiled the single-output serving fn")
         image1 = np.asarray(image1, np.float32)
         image2 = np.asarray(image2, np.float32)
         b, h, w, _ = image1.shape
         left, right, top, bottom = pad_amounts(h, w)
         hp, wp = h + top + bottom, w + left + right
 
-        bucket = self._select_bucket(b, hp, wp)
-        if bucket is None:
-            bb, bh = b, hp
-            if self.mesh is not None:
-                # batch rides the 'data' axis, height the 'spatial' axis —
-                # round the ad-hoc bucket up so every device gets whole
-                # examples and whole feature rows (the bucket's zero-fill
-                # + output crop absorbs the padding either way)
-                bg, hg = self._mesh_grain()
-                bb = -(-b // bg) * bg
-                bh = -(-hp // hg) * hg
-            bucket = (bb, bh, wp)  # compile-on-miss, cached thereafter
+        bucket = self._route(b, hp, wp)  # compile-on-miss, cached
         bb, bh, bw = bucket
+        # one snapshot of the weight tree serves this whole dispatch:
+        # a concurrent update_weights swaps the reference, never the
+        # tree a running dispatch compiled-against/called-with
+        with self._lock:
+            variables = self.variables
+        exe = self._get_executable(bucket, variables)  # validates
+        # extent under a mesh; compiles outside the lock
         # edge-pad to stride alignment (InputPadder semantics), zero-fill the
         # rest of the bucket
         align = ((0, 0), (top, bottom), (left, right), (0, 0))
         fill = ((0, bb - b), (0, bh - hp), (0, bw - wp), (0, 0))
         i1 = np.pad(np.pad(image1, align, mode="edge"), fill)
         i2 = np.pad(np.pad(image2, align, mode="edge"), fill)
-        exe = self._get_executable(bucket)  # validates extent under a mesh
+        args = [i1, i2]
+        if self.warm_start:
+            finit = np.zeros((bb, bh // 8, bw // 8, 2), np.float32)
+            if flow_init is not None:
+                fi = np.asarray(flow_init, np.float32)
+                want = (b, hp // 8, wp // 8, 2)
+                if fi.shape != want:
+                    raise ValueError(
+                        f"flow_init shape {fi.shape} != {want} (1/8 of "
+                        "the ÷8-padded request)")
+                finit[:b, :hp // 8, :wp // 8, :] = fi
+            args.append(finit)
         if self.mesh is not None:
-            i1 = jax.device_put(i1, self._in_shard)
-            i2 = jax.device_put(i2, self._in_shard)
+            args = [jax.device_put(a, self._in_shard) for a in args]
         else:
-            i1, i2 = jnp.asarray(i1), jnp.asarray(i2)
-        flow = exe(self.variables, i1, i2)
-        return np.asarray(flow[:b, top:top + h, left:left + w, :])
+            args = [jnp.asarray(a) for a in args]
+        out = exe(variables, *args)
+        if self.warm_start:
+            flow_low, flow = out
+        else:
+            flow = out
+        flow = np.asarray(flow[:b, top:top + h, left:left + w, :])
+        if return_low:
+            # cropped to the ÷8-padded request (NOT the raw frame): the
+            # align padding is identical for the next same-shape frame,
+            # so this feeds straight back as its flow_init
+            return flow, np.asarray(flow_low[:b, :hp // 8, :wp // 8, :])
+        return flow
 
     def infer(self, images: Sequence[np.ndarray], batch_size: int = 4,
               time_it: bool = False) -> List[np.ndarray]:

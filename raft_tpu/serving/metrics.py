@@ -1,0 +1,244 @@
+"""Serving observability: latency histograms, occupancy, shed counters.
+
+Built into the serving front-end, not bolted on: the scheduler records
+every request's life (enqueue -> dispatch -> complete) here, and a
+snapshot answers the operator questions a serving stack lives by — how
+long are callers waiting and where (queue vs device), how full are the
+compiled buckets actually running (batch occupancy vs the
+one-request-per-dispatch baseline), how deep is the queue, and how much
+work was shed or missed its deadline.
+
+Snapshots append to ``metrics.jsonl`` in the trainer Logger's format
+(one JSON object per line carrying a ``step`` key,
+training/logger.py:96-103) so the same ``tail -f`` / ``jq`` tooling
+reads training and serving records side by side. Deliberately jax-free.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import os
+import threading
+from typing import Dict, List, Optional
+
+#: 1-2-5 log ladder, 0.1 ms .. 60 s — everything from a warm CPU
+#: dispatch to a cold-compile stall lands inside it
+_BOUNDS_MS: List[float] = [
+    m * decade
+    for decade in (0.1, 1.0, 10.0, 100.0, 1000.0, 10000.0)
+    for m in (1, 2, 5)
+] + [60000.0]
+
+
+class LatencyHistogram:
+    """Fixed log-ladder histogram. Percentile estimates report the
+    matched bucket's upper bound — pessimistic but stable, and two
+    histograms with the same ladder merge by adding counts."""
+
+    __slots__ = ("bounds", "counts", "count", "total", "max")
+
+    def __init__(self, bounds: Optional[List[float]] = None):
+        self.bounds = list(_BOUNDS_MS if bounds is None else bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def observe(self, ms: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, ms)] += 1
+        self.count += 1
+        self.total += ms
+        if ms > self.max:
+            self.max = ms
+
+    def quantile(self, q: float) -> float:
+        if not self.count:
+            return 0.0
+        target = max(1, math.ceil(q * self.count))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return self.bounds[i] if i < len(self.bounds) else self.max
+        return self.max
+
+    def snapshot(self) -> Dict:
+        mean = self.total / self.count if self.count else 0.0
+        return {"count": self.count, "mean_ms": round(mean, 3),
+                "max_ms": round(self.max, 3),
+                "p50_ms": self.quantile(0.5),
+                "p99_ms": self.quantile(0.99),
+                "counts": list(self.counts)}
+
+
+#: per-request latency stages: enqueue->dispatch, dispatch->complete,
+#: and their sum
+_STAGES = ("queue", "device", "total")
+
+
+class ServingMetrics:
+    """Thread-safe counters + per-bucket histograms for the scheduler.
+
+    ``path``: optional ``metrics.jsonl`` destination for
+    :meth:`write_snapshot` (appended, Logger-style). Counter semantics:
+    ``shed`` is work REJECTED at submit (queue full — backpressure),
+    ``deadline_missed`` is work that expired while still queued,
+    ``abandoned_inflight`` counts dispatched requests the scheduler
+    gave up on — by design never incremented; the acceptance drill
+    pins it at zero.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, Dict] = {}
+        self._latency = LatencyHistogram()       # all-bucket total
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.shed = 0
+        self.deadline_missed = 0
+        self.cancelled = 0
+        self.abandoned_inflight = 0
+        self.dispatches = 0
+        self.depth_last = 0
+        self.depth_max = 0
+        self._depth_sum = 0
+        self._depth_samples = 0
+        self._snapshots = 0
+
+    # -- recording --------------------------------------------------------
+
+    def _bucket(self, key: str) -> Dict:
+        b = self._buckets.get(key)
+        if b is None:
+            b = {"dispatches": 0, "filled": 0, "capacity": 0}
+            for stage in _STAGES:
+                b[stage] = LatencyHistogram()
+            self._buckets[key] = b
+        return b
+
+    def _depth(self, depth: int) -> None:
+        self.depth_last = depth
+        self.depth_max = max(self.depth_max, depth)
+        self._depth_sum += depth
+        self._depth_samples += 1
+
+    def record_submit(self, depth: int) -> None:
+        with self._lock:
+            self.submitted += 1
+            self._depth(depth)
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def record_deadline_miss(self, n: int = 1) -> None:
+        with self._lock:
+            self.deadline_missed += n
+
+    def record_cancelled(self, n: int = 1) -> None:
+        with self._lock:
+            self.cancelled += n
+
+    def record_abandoned_inflight(self, n: int = 1) -> None:
+        with self._lock:
+            self.abandoned_inflight += n
+
+    def record_dispatch(self, bucket: str, filled: int, capacity: int,
+                        depth: int) -> None:
+        with self._lock:
+            self.dispatches += 1
+            b = self._bucket(bucket)
+            b["dispatches"] += 1
+            b["filled"] += filled
+            b["capacity"] += capacity
+            self._depth(depth)
+
+    def record_complete(self, bucket: str, queue_ms: float,
+                        device_ms: float) -> None:
+        with self._lock:
+            self.completed += 1
+            b = self._bucket(bucket)
+            b["queue"].observe(queue_ms)
+            b["device"].observe(device_ms)
+            b["total"].observe(queue_ms + device_ms)
+            self._latency.observe(queue_ms + device_ms)
+
+    def record_failure(self, n: int = 1) -> None:
+        with self._lock:
+            self.failed += n
+
+    # -- reporting --------------------------------------------------------
+
+    def snapshot(self, executables: Optional[int] = None) -> Dict:
+        """One self-contained record: counters, queue-depth gauges,
+        occupancy vs the one-request-per-dispatch baseline, and the
+        per-bucket stage histograms."""
+        with self._lock:
+            self._snapshots += 1
+            filled = sum(b["filled"] for b in self._buckets.values())
+            capacity = sum(b["capacity"] for b in self._buckets.values())
+            depth_mean = (self._depth_sum / self._depth_samples
+                          if self._depth_samples else 0.0)
+            rec = {
+                # the Logger contract: every jsonl record carries "step"
+                "step": self._snapshots,
+                "kind": "serving",
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "shed": self.shed,
+                "deadline_missed": self.deadline_missed,
+                "cancelled": self.cancelled,
+                "abandoned_inflight": self.abandoned_inflight,
+                "dispatches": self.dispatches,
+                "executables": executables,
+                "queue_depth": {"last": self.depth_last,
+                                "max": self.depth_max,
+                                "mean": round(depth_mean, 3)},
+                "occupancy": {
+                    "filled": filled,
+                    "capacity": capacity,
+                    "mean": round(filled / capacity, 4) if capacity
+                    else 0.0,
+                    # what the same dispatch count would score carrying
+                    # ONE request each — the no-coalescing strawman the
+                    # drill must strictly beat
+                    "one_per_dispatch_baseline":
+                        round(self.dispatches / capacity, 4) if capacity
+                        else 0.0,
+                },
+                "latency": self._latency.snapshot(),
+                "hist_bounds_ms": list(_BOUNDS_MS),
+                "buckets": {
+                    key: {
+                        "dispatches": b["dispatches"],
+                        "filled": b["filled"],
+                        "capacity": b["capacity"],
+                        "occupancy": round(b["filled"] / b["capacity"], 4)
+                        if b["capacity"] else 0.0,
+                        **{stage: b[stage].snapshot()
+                           for stage in _STAGES},
+                    }
+                    for key, b in sorted(self._buckets.items())
+                },
+            }
+        return rec
+
+    def write_snapshot(self, executables: Optional[int] = None,
+                       path: Optional[str] = None) -> Dict:
+        """Append one snapshot line to ``path`` (default: the ctor's);
+        returns the record."""
+        rec = self.snapshot(executables=executables)
+        dest = path or self.path
+        if dest is None:
+            raise ValueError("no metrics path configured")
+        parent = os.path.dirname(dest)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(dest, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        return rec

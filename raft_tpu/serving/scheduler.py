@@ -1,0 +1,377 @@
+"""Async micro-batching scheduler: the serving front-end over the engine.
+
+``RAFTEngine`` is a synchronous bucket router — one caller drives it at
+a time, and a lone request pads a bucket's whole batch dimension with
+zeros. Production TPU serving wins by decoupling request ARRIVAL from
+device DISPATCH and coalescing ragged traffic into a small set of
+pre-compiled shapes (the lesson Ragged Paged Attention draws for LLM
+inference kernels on TPU, arXiv 2604.15464). This module is that
+front-end: requests from any number of callers land in one bounded
+queue, a single dispatcher thread groups same-shape requests into a
+micro-batch, and the bucket's batch dimension fills with *different
+callers' work* instead of padding.
+
+Robustness contract (first-class, not best-effort):
+
+- **Backpressure**: the queue is bounded; a full queue rejects NEW work
+  with :class:`BackpressureError` (counted as shed) — load shedding
+  never touches accepted or in-flight requests.
+- **Deadlines** are enforced while QUEUED only: a request that expires
+  before dispatch fails fast with :class:`DeadlineExceeded`; a
+  dispatched request always runs to completion (the executable is
+  non-preemptible anyway) — zero deadline-abandoned in-flight work, by
+  construction (``Future.set_running_or_notify_cancel`` pins it).
+- **Drain on shutdown**: ``close(drain=True)`` stops intake, finishes
+  everything queued, and joins the worker — no leaked threads (the
+  PR-3 loader-semaphore lesson, one layer up).
+- **Live weight swap**: ``update_weights`` is safe under concurrent
+  dispatch — the engine snapshots its weight tree once per dispatch
+  under its lock, so a swap lands between dispatches, never inside one.
+
+Fault drills: every micro-batch passes through the ``serve.request``
+fault site (testing/faults) — ``raise`` fails just that batch's
+futures (the worker survives), ``hang`` models a half-up device
+stalling dispatch until the queue sheds.
+
+Observability rides along in :class:`~raft_tpu.serving.metrics.
+ServingMetrics`: per-bucket latency histograms for each stage
+(enqueue->dispatch->complete), batch occupancy, queue depth, shed and
+deadline-miss counters, snapshotted to ``metrics.jsonl`` on close and
+dumpable on demand (``write_metrics``).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from typing import Deque, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.ops.padding import pad_amounts
+from raft_tpu.serving.metrics import ServingMetrics
+from raft_tpu.testing.faults import fault_point
+
+
+class BackpressureError(RuntimeError):
+    """Queue at max_queue: shed — the submitter should back off/retry."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline expired while it was still queued."""
+
+
+class SchedulerClosed(RuntimeError):
+    """submit() after close(), or queued work dropped by a no-drain
+    close."""
+
+
+class ServeResult(NamedTuple):
+    flow: np.ndarray               #: (H, W, 2), cropped to the request
+    flow_low: Optional[np.ndarray]  #: (hp/8, wp/8, 2) in ÷8-padded frame
+    #: space when requested (``want_low``) — the next frame's warm-start
+    #: substrate — else None
+
+
+class _Request:
+    __slots__ = ("image1", "image2", "key", "flow_init", "want_low",
+                 "future", "t_submit", "deadline")
+
+    def __init__(self, image1, image2, key, flow_init, want_low,
+                 deadline):
+        self.image1 = image1
+        self.image2 = image2
+        self.key = key                  # (H, W) — the coalescing group
+        self.flow_init = flow_init
+        self.want_low = want_low
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+        self.deadline = deadline        # absolute monotonic, or None
+
+
+class MicroBatchScheduler:
+    """Bounded-queue micro-batching front-end over a ``RAFTEngine``.
+
+    ``max_queue``: pending-request bound (backpressure past it).
+    ``max_batch``: coalescing ceiling per dispatch; for a spatial shape
+    with no precompiled bucket, ONE bucket is pre-warmed at this batch
+    so later micro-batches batch-fill instead of compiling per fill
+    count. ``gather_window_s``: how long dispatch holds an underfull
+    micro-batch open for concurrent submitters — the latency/occupancy
+    knob (bounded; an already-full batch never waits).
+    """
+
+    def __init__(self, engine, *, max_queue: int = 64, max_batch: int = 8,
+                 gather_window_s: float = 0.002,
+                 metrics: Optional[ServingMetrics] = None,
+                 metrics_path: Optional[str] = None):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.max_batch = int(max_batch)
+        self.gather_window_s = float(gather_window_s)
+        self.metrics = metrics or ServingMetrics(metrics_path)
+        self._cv = threading.Condition()
+        self._q: Deque[_Request] = collections.deque()
+        self._capacity: Dict[Tuple[int, int], int] = {}
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="MicroBatchScheduler-dispatch",
+            daemon=True)
+        self._worker.start()
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, image1, image2, *, deadline_s: Optional[float] = None,
+               flow_init: Optional[np.ndarray] = None,
+               want_low: bool = False) -> Future:
+        """Enqueue ONE ``(H, W, 3)`` frame pair; returns a Future
+        resolving to :class:`ServeResult`. Raises
+        :class:`BackpressureError` when the queue is full and
+        :class:`SchedulerClosed` after ``close()``."""
+        image1 = np.asarray(image1, np.float32)
+        image2 = np.asarray(image2, np.float32)
+        if image1.ndim != 3 or image1.shape[-1] != 3:
+            raise ValueError(
+                f"submit takes one (H, W, 3) frame pair, got "
+                f"{image1.shape} — batching is the scheduler's job")
+        if image1.shape != image2.shape:
+            raise ValueError(f"frame shapes differ: {image1.shape} vs "
+                             f"{image2.shape}")
+        if ((flow_init is not None or want_low)
+                and not getattr(self.engine, "warm_start", False)):
+            raise ValueError(
+                "flow_init/want_low need a warm_start=True engine")
+        if flow_init is not None:
+            flow_init = np.asarray(flow_init, np.float32)
+            h, w = image1.shape[:2]
+            left, right, top, bottom = pad_amounts(h, w)
+            want = ((h + top + bottom) // 8, (w + left + right) // 8, 2)
+            if flow_init.shape != want:
+                # validated HERE so a malformed warm start fails ITS
+                # caller alone — at dispatch time the row assignment
+                # would throw inside the shared try and fail (or, if
+                # broadcastable, silently corrupt) the whole coalesced
+                # micro-batch, other callers included
+                raise ValueError(
+                    f"flow_init shape {flow_init.shape} != {want} (1/8 "
+                    "of the ÷8-padded frame)")
+            if not np.isfinite(flow_init).all():
+                # a NaN warm start would only poison this caller's own
+                # row, but fail it here with a cause instead of
+                # returning NaN flow from the device
+                raise ValueError("flow_init contains non-finite values")
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        req = _Request(image1, image2, tuple(image1.shape[:2]),
+                       flow_init, want_low, deadline)
+        with self._cv:
+            if self._closed:
+                raise SchedulerClosed("scheduler is closed")
+            if len(self._q) >= self.max_queue:
+                self.metrics.record_shed()
+                raise BackpressureError(
+                    f"queue full ({self.max_queue} pending) — shedding "
+                    "new work; retry with backoff")
+            self._q.append(req)
+            self.metrics.record_submit(depth=len(self._q))
+            self._cv.notify()
+        return req.future
+
+    def update_weights(self, variables) -> None:
+        """Live checkpoint swap; atomic wrt in-flight micro-batches
+        (the engine snapshots its tree once per dispatch)."""
+        self.engine.update_weights(variables)
+
+    # -- dispatch loop -----------------------------------------------------
+
+    def _shape_capacity(self, key: Tuple[int, int]) -> int:
+        cap = self._capacity.get(key)
+        if cap is None:
+            h, w = key
+            fit = self.engine.bucket_capacity(h, w)
+            if fit is None:
+                # no compiled bucket fits this spatial shape: pre-warm
+                # exactly one at max_batch so every later fill count
+                # batch-fills into it (executable count stays one per
+                # shape, the H3 discipline)
+                fit = self.engine.ensure_bucket(self.max_batch, h, w)[0]
+            cap = max(1, min(fit, self.max_batch))
+            self._capacity[key] = cap
+        return cap
+
+    def _expire(self, req: _Request, now: float) -> bool:
+        if req.deadline is not None and now > req.deadline:
+            self.metrics.record_deadline_miss()
+            req.future.set_exception(DeadlineExceeded(
+                f"deadline expired after {now - req.t_submit:.3f}s in "
+                "queue (never dispatched)"))
+            return True
+        return False
+
+    def _gather(self, key: Tuple[int, int], capacity: int) -> None:
+        """Hold dispatch open briefly so concurrent submitters can fill
+        the micro-batch — bounded by ``gather_window_s``; a full batch
+        (or a closing scheduler) never waits."""
+        t_end = time.monotonic() + self.gather_window_s
+        while True:
+            with self._cv:
+                if (self._closed
+                        or sum(1 for r in self._q if r.key == key)
+                        >= capacity):
+                    return
+            if time.monotonic() >= t_end:
+                return
+            time.sleep(min(0.0005, self.gather_window_s))
+
+    def _take(self, key: Tuple[int, int], capacity: int
+              ) -> List[_Request]:
+        """Pop up to ``capacity`` same-shape requests FIFO, expiring
+        stale deadlines (and reaping caller-cancelled futures) across
+        the whole queue on the way."""
+        now = time.monotonic()
+        taken: List[_Request] = []
+        keep: Deque[_Request] = collections.deque()
+        with self._cv:
+            for r in self._q:
+                if r.future.cancelled():
+                    self.metrics.record_cancelled()
+                elif self._expire(r, now):
+                    pass
+                elif r.key == key and len(taken) < capacity:
+                    taken.append(r)
+                else:
+                    keep.append(r)
+            self._q = keep
+        return taken
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait(timeout=0.05)
+                if not self._q:
+                    if self._closed:
+                        return
+                    continue
+                key = self._q[0].key
+            try:
+                # capacity may compile a bucket — never under the queue
+                # lock (submitters would shed through the whole
+                # compile)
+                capacity = self._shape_capacity(key)
+            except Exception as exc:
+                # an unservable shape (mesh-invalid extent, a compile
+                # failure) fails ITS requests — it must not kill the
+                # dispatcher and strand every queued future unsettled
+                # behind a dead thread
+                doomed = self._take(key, self.max_batch)
+                self.metrics.record_failure(len(doomed))
+                for r in doomed:
+                    if not r.future.done():
+                        r.future.set_exception(exc)
+                continue
+            self._gather(key, capacity)
+            batch = self._take(key, capacity)
+            if batch:
+                self._dispatch(key, batch)
+
+    def _dispatch(self, key: Tuple[int, int], batch: List[_Request]
+                  ) -> None:
+        live: List[_Request] = []
+        for r in batch:
+            # once this returns True the future can no longer be
+            # cancelled: a dispatched request is never abandoned — the
+            # acceptance invariant behind metrics.abandoned_inflight==0
+            if r.future.set_running_or_notify_cancel():
+                live.append(r)
+            else:
+                self.metrics.record_cancelled()
+        if not live:
+            return
+        h, w = key
+        n = len(live)
+        t_disp = time.monotonic()
+        try:  # EVERYTHING here routes failures to the batch's futures —
+            # nothing may escape and kill the dispatcher thread
+            bucket = self.engine.route_bucket(n, h, w)
+            label = "x".join(map(str, bucket))
+            with self._cv:
+                depth = len(self._q)
+            self.metrics.record_dispatch(label, filled=n,
+                                         capacity=bucket[0], depth=depth)
+            fault_point("serve.request")
+            i1 = np.stack([r.image1 for r in live])
+            i2 = np.stack([r.image2 for r in live])
+            if getattr(self.engine, "warm_start", False):
+                finit = None
+                if any(r.flow_init is not None for r in live):
+                    left, right, top, bottom = pad_amounts(h, w)
+                    lh = (h + top + bottom) // 8
+                    lw = (w + left + right) // 8
+                    # zero rows are cold starts: warm sessions and
+                    # one-shot requests share the dispatch
+                    finit = np.zeros((n, lh, lw, 2), np.float32)
+                    for i, r in enumerate(live):
+                        if r.flow_init is not None:
+                            finit[i] = r.flow_init
+                flows, lows = self.engine.infer_batch(
+                    i1, i2, flow_init=finit, return_low=True)
+            else:
+                flows = self.engine.infer_batch(i1, i2)
+                lows = None
+            t_done = time.monotonic()
+            for i, r in enumerate(live):
+                low = lows[i] if (lows is not None and r.want_low) \
+                    else None
+                r.future.set_result(ServeResult(flows[i], low))
+                self.metrics.record_complete(
+                    label, queue_ms=(t_disp - r.t_submit) * 1e3,
+                    device_ms=(t_done - t_disp) * 1e3)
+        except Exception as exc:  # route to the callers; worker survives
+            failed = [r for r in live if not r.future.done()]
+            self.metrics.record_failure(len(failed))
+            for r in failed:
+                r.future.set_exception(exc)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def executable_count(self) -> int:
+        return len(self.engine._compiled)
+
+    def write_metrics(self, path: Optional[str] = None) -> Dict:
+        """Dump a metrics snapshot on demand (appends a jsonl line)."""
+        return self.metrics.write_snapshot(
+            executables=self.executable_count(), path=path)
+
+    def close(self, drain: bool = True, timeout: float = 120.0) -> None:
+        """Stop intake; ``drain=True`` finishes everything queued
+        first, ``drain=False`` fails pending work with
+        :class:`SchedulerClosed`. Joins the worker (leaked dispatch
+        threads are a bug, not a shutdown mode) and writes a final
+        metrics snapshot when a metrics path is configured.
+        Idempotent."""
+        with self._cv:
+            first = not self._closed
+            self._closed = True
+            if not drain:
+                while self._q:
+                    r = self._q.popleft()
+                    if not r.future.done():
+                        r.future.set_exception(SchedulerClosed(
+                            "dropped by no-drain close"))
+            self._cv.notify_all()
+        self._worker.join(timeout)
+        if self._worker.is_alive():
+            raise RuntimeError(
+                f"scheduler worker failed to drain within {timeout}s")
+        if first and self.metrics.path:
+            self.metrics.write_snapshot(
+                executables=self.executable_count())
+
+    def __enter__(self) -> "MicroBatchScheduler":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(drain=exc_type is None)

@@ -18,7 +18,12 @@ Plan entries (a list of dicts, or ``{"faults": [...]}``):
     fsync'd tmp file and the rename, ``kind="corrupt"`` smashes the
     completed file on disk, i.e. post-save bit rot the load-time
     manifest check must catch), ``ckpt.orbax_save`` (full-state saves —
-    ``kind="corrupt"`` smashes a file of the just-written step).
+    ``kind="corrupt"`` smashes a file of the just-written step),
+    ``serve.request`` (per micro-batch dispatch in the serving
+    scheduler's worker, serving/scheduler.py — ``kind="raise"`` fails
+    just that batch's futures and the worker survives, ``kind="hang"``
+    models a half-up device stalling dispatch until the bounded queue
+    sheds and queued deadlines expire).
 ``at``
     1-based occurrence at which the fault fires (default 1). Each entry
     fires exactly once.

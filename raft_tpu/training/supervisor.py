@@ -44,6 +44,7 @@ across children.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -86,6 +87,17 @@ def describe_exit(rc: int) -> str:
     return f"child died (exit {rc})"
 
 
+def exit_class(rc: int) -> str:
+    """Coarse label for metrics/alerting: which failure mode was it."""
+    if rc == WEDGED_EXIT_CODE:
+        return "wedge"
+    if rc == CRASH_EXIT_CODE:
+        return "crash-drill"
+    if rc < 0:
+        return "signal"
+    return "usage-error" if rc in NON_RETRYABLE_EXIT_CODES else "crash"
+
+
 class Supervisor:
     """Run ``argv`` as a supervised child until clean exit or give-up.
 
@@ -93,6 +105,13 @@ class Supervisor:
     the deterministic-crash rule; pass ``probe_step`` to override it,
     or neither to supervise on ``max_restarts`` alone. ``launch`` and
     ``sleep`` are injectable for tests.
+
+    ``metrics_path``: optional ``metrics.jsonl`` the supervisor appends
+    restart events to (attempt, exit class, restored step, backoff) —
+    the alerting substrate: a dashboard tailing the trainer's Logger
+    records sees the restarts interleaved with the training curves.
+    Append-only JSON lines, the Logger's format; a failed append is
+    logged and ignored (observability must never take down recovery).
     """
 
     def __init__(self, argv: Sequence[str], *, max_restarts: int = 5,
@@ -101,7 +120,8 @@ class Supervisor:
                  base_s: float = 1.0, max_s: float = 60.0,
                  jitter: float = 0.5, rng=None,
                  launch: Optional[Callable[[int, dict], int]] = None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 metrics_path: Optional[str] = None):
         self.argv = list(argv)
         self.max_restarts = int(max_restarts)
         if probe_step is None and ckpt_dir is not None:
@@ -113,6 +133,7 @@ class Supervisor:
         self.restarts = 0
         self._child: Optional[subprocess.Popen] = None
         self._stop_signal: Optional[int] = None
+        self._metrics_path = metrics_path
 
     def _spawn(self, attempt: int, env: dict) -> int:
         proc = subprocess.Popen(self.argv, env=env)
@@ -139,6 +160,20 @@ class Supervisor:
 
     def _log(self, msg: str) -> None:
         print(f"[supervisor] {msg}", file=sys.stderr, flush=True)
+
+    def _record(self, event: str, **fields) -> None:
+        """Append one event record to metrics.jsonl (Logger format)."""
+        if self._metrics_path is None:
+            return
+        rec = {"event": event, "time": time.time(), **fields}
+        try:
+            parent = os.path.dirname(self._metrics_path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(self._metrics_path, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(rec) + "\n")
+        except OSError as exc:
+            self._log(f"metrics append failed ({exc}) — continuing")
 
     @staticmethod
     def _exit_code(rc: int) -> int:
@@ -192,10 +227,14 @@ class Supervisor:
                 if self.restarts:
                     self._log(f"child exited clean after "
                               f"{self.restarts} restart(s)")
+                    self._record("supervisor_recovered",
+                                 restarts=self.restarts)
                 return 0
             why = describe_exit(rc)
             if rc in NON_RETRYABLE_EXIT_CODES:
                 self._log(f"{why} — usage error, not retrying")
+                self._record("supervisor_give_up", reason="usage-error",
+                             exit_code=rc, attempt=self.restarts)
                 return self._exit_code(rc)
             fail_step = self._probe() if self._probe is not None else None
             # the deterministic-crash rule judges CRASH-class exits
@@ -213,17 +252,29 @@ class Supervisor:
                     f"{why} with the restore point still at step "
                     f"{fail_step} — same failure twice with no progress "
                     "is a deterministic crash, giving up")
+                self._record("supervisor_give_up",
+                             reason="deterministic-crash", exit_code=rc,
+                             attempt=self.restarts,
+                             restored_step=fail_step)
                 return self._exit_code(rc)
             prev_fail_step = fail_step if crash_class else _NO_FAILURE
             if self.restarts >= self.max_restarts:
                 self._log(f"{why} — max_restarts={self.max_restarts} "
                           "exhausted, giving up")
+                self._record("supervisor_give_up",
+                             reason="max-restarts", exit_code=rc,
+                             attempt=self.restarts,
+                             restored_step=fail_step)
                 return self._exit_code(rc)
             self.restarts += 1
             delay = next(self._delays)
             self._log(f"{why} — restart {self.restarts}/"
                       f"{self.max_restarts} (resume point: step "
                       f"{fail_step}) in {delay:.1f}s")
+            self._record("supervisor_restart", attempt=self.restarts,
+                         exit_code=rc, exit_class=exit_class(rc),
+                         restored_step=fail_step,
+                         backoff_s=round(delay, 3))
             # sliced so a stop signal cuts the backoff short (PEP 475
             # would otherwise resume a single long sleep to completion
             # and relaunch); the loop-top check turns it into an exit

@@ -561,6 +561,43 @@ class TestSupervisorUnit:
         assert sup.run() == 2
         assert len(launches) == 1
 
+    def test_restart_events_appended_to_metrics(self, tmp_path):
+        """The alerting substrate: every restart decision lands in
+        metrics.jsonl (attempt, exit class, restored step, backoff)
+        next to the trainer Logger's records."""
+        mpath = str(tmp_path / "runs" / "metrics.jsonl")
+        sup, _ = self._sup([WEDGED_EXIT_CODE, 1, 0], [3, 5],
+                           metrics_path=mpath)
+        assert sup.run() == 0
+        recs = [json.loads(line) for line in open(mpath)]
+        restarts = [r for r in recs if r["event"] == "supervisor_restart"]
+        assert [r["attempt"] for r in restarts] == [1, 2]
+        assert restarts[0]["exit_class"] == "wedge"
+        assert restarts[0]["restored_step"] == 3
+        assert restarts[1]["exit_class"] == "crash"
+        assert restarts[1]["restored_step"] == 5
+        assert all(r["backoff_s"] >= 0 and "time" in r for r in restarts)
+        recovered = [r for r in recs
+                     if r["event"] == "supervisor_recovered"]
+        assert len(recovered) == 1 and recovered[0]["restarts"] == 2
+
+    def test_give_up_event_recorded(self, tmp_path):
+        mpath = str(tmp_path / "metrics.jsonl")
+        sup, _ = self._sup([1, 1], [5, 5], max_restarts=10,
+                           metrics_path=mpath)
+        assert sup.run() == 1
+        recs = [json.loads(line) for line in open(mpath)]
+        give_up = [r for r in recs if r["event"] == "supervisor_give_up"]
+        assert len(give_up) == 1
+        assert give_up[0]["reason"] == "deterministic-crash"
+        assert give_up[0]["restored_step"] == 5
+
+    def test_no_metrics_path_is_quiet(self):
+        """Without metrics_path the supervisor writes nothing (and
+        doesn't crash trying) — the embedded/test default."""
+        sup, _ = self._sup([WEDGED_EXIT_CODE, 0], [4])
+        assert sup.run() == 0  # _record no-ops throughout
+
     def test_preemption_signal_retried(self):
         sup, launches = self._sup([-15, 0], [7])
         assert sup.run() == 0
@@ -861,6 +898,11 @@ class TestTrainCLISupervise:
         assert captured["max_restarts"] == 2
         assert captured["ckpt_dir"] == os.path.join(str(tmp_path), "n",
                                                     "chairs")
+        # restart events land in the SAME file the trainer's Logger
+        # writes (trainer.py: Logger(join(log_dir, name))) — a
+        # dashboard tailing the curves sees the restarts too
+        assert captured["metrics_path"] == os.path.join(
+            "runs", "n", "metrics.jsonl")
 
 
 @pytest.mark.slow  # ~190 s (three subprocess training runs + a real

@@ -1,0 +1,480 @@
+"""Serving front-end acceptance: async micro-batch coalescing,
+deadlines, backpressure, drain-on-shutdown, warm-start sessions, and
+the metrics surface — the ISSUE-6 ragged-traffic drill plus the
+fault-injection matrix for the ``serve.request`` site."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import RAFT
+from raft_tpu.serving.engine import RAFTEngine
+from raft_tpu.serving.scheduler import (BackpressureError, DeadlineExceeded,
+                                        MicroBatchScheduler, SchedulerClosed,
+                                        ServeResult)
+from raft_tpu.serving.session import VideoSession
+from raft_tpu.testing import faults
+
+SHAPES = [(32, 32), (40, 40)]
+BUCKET_BATCH = 3
+
+
+@pytest.fixture(scope="module")
+def small_setup():
+    cfg = RAFTConfig(small=True)
+    model = RAFT(cfg)
+    img = jnp.zeros((1, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+    return cfg, variables
+
+
+@pytest.fixture(scope="module")
+def engine(small_setup):
+    """One warm-start engine for the whole module: two documented
+    buckets, one per drill shape — every test below must leave
+    ``len(_compiled)`` at exactly these two."""
+    cfg, variables = small_setup
+    return RAFTEngine(variables, cfg, iters=1,
+                      envelope=[(BUCKET_BATCH, h, w) for h, w in SHAPES],
+                      precompile=True, warm_start=True)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm()
+
+
+def _pair(rng, h=32, w=32):
+    return (rng.rand(h, w, 3).astype(np.float32) * 255,
+            rng.rand(h, w, 3).astype(np.float32) * 255)
+
+
+def _no_leaked_workers(before, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t not in before and t.is_alive()
+                  and t.name.startswith("MicroBatchScheduler")]
+        if not leaked:
+            return []
+        time.sleep(0.05)
+    return leaked
+
+
+class TestRaggedTrafficDrill:
+    def test_acceptance_drill(self, engine, small_setup, tmp_path):
+        """The ISSUE-6 acceptance criterion: mixed shapes + ragged
+        tails, two concurrent submitters — every non-shed request
+        served, executable count pinned at the documented bucket
+        count, occupancy strictly above one-request-per-dispatch,
+        zero deadline-abandoned in-flight, and a metrics.jsonl
+        snapshot carrying the full surface."""
+        cfg, variables = small_setup
+        from raft_tpu.cli.serve_bench import run_drill
+
+        mpath = str(tmp_path / "metrics.jsonl")
+        summary = run_drill(variables, cfg, shapes=SHAPES, requests=14,
+                            submitters=2, bucket_batch=BUCKET_BATCH,
+                            deadline_s=120.0, gather_window_s=0.05,
+                            metrics_path=mpath, engine=engine)
+        # all non-shed requests served (14 across both shapes: 7 per
+        # shape — ragged against the batch-3 buckets)
+        assert summary["shed"] == 0 and summary["errors"] == 0
+        assert summary["deadline_missed"] == 0
+        assert summary["served"] == summary["submitted"] == 14
+        # cross-caller coalescing kept the executable count at the
+        # documented bucket count (the H3 invariant, scheduler layer)
+        assert summary["executables"] == len(SHAPES)
+        assert sorted(engine._compiled) == [
+            (BUCKET_BATCH, h, w) for h, w in SHAPES]
+        # mean batch occupancy strictly above the one-request-per-
+        # dispatch baseline: the batch dim filled with OTHER callers'
+        # work, not padding
+        assert summary["mean_occupancy"] > summary["baseline_occupancy"]
+        assert summary["dispatches"] < summary["served"]
+        # no in-flight request was deadline-abandoned
+        assert summary["abandoned_inflight"] == 0
+
+        recs = [json.loads(line) for line in open(mpath)]
+        rec = recs[-1]
+        # the trainer Logger's jsonl contract + the serving surface
+        assert rec["step"] >= 1 and rec["kind"] == "serving"
+        assert rec["shed"] == 0 and rec["executables"] == len(SHAPES)
+        assert rec["queue_depth"]["max"] >= 1
+        assert (rec["occupancy"]["mean"]
+                > rec["occupancy"]["one_per_dispatch_baseline"])
+        used = [b for b in rec["buckets"].values() if b["dispatches"]]
+        assert used, "no per-bucket records"
+        for b in used:
+            for stage in ("queue", "device", "total"):
+                assert b[stage]["count"] == b["filled"]
+                assert b[stage]["p50_ms"] <= b[stage]["p99_ms"]
+
+    def test_sessions_coalesce_with_oneshot_traffic(self, engine,
+                                                    small_setup):
+        """Warm-start sessions and one-shot submitters share buckets:
+        the drill with sessions on still pins the executable count."""
+        cfg, variables = small_setup
+        from raft_tpu.cli.serve_bench import run_drill
+
+        summary = run_drill(variables, cfg, shapes=SHAPES, requests=6,
+                            submitters=2, bucket_batch=BUCKET_BATCH,
+                            sessions=2, session_frames=3,
+                            gather_window_s=0.02, engine=engine)
+        assert summary["errors"] == 0 and summary["shed"] == 0
+        assert summary["session_pairs"] == 2 * 3
+        # up to 2 warm pairs per stream; random-weight flows that blow
+        # out of the low-res frame (correctly) degrade to cold starts
+        assert 1 <= summary["warm_submits"] <= 2 * 2
+        assert summary["executables"] == len(SHAPES)
+        assert summary["abandoned_inflight"] == 0
+
+
+class TestSchedulerBasics:
+    def test_single_request_matches_engine_direct(self, engine, rng):
+        i1, i2 = _pair(rng)
+        direct = engine.infer_batch(i1[None], i2[None])[0]
+        with MicroBatchScheduler(engine,
+                                 gather_window_s=0.0) as sched:
+            res = sched.submit(i1, i2).result(timeout=120)
+        assert isinstance(res, ServeResult)
+        # same executable, batch fill is per-sample neutral (measured
+        # ~3e-5 px; tests/test_serving.py ragged-tail test)
+        np.testing.assert_allclose(res.flow, direct, atol=1e-3,
+                                   rtol=1e-4)
+        assert res.flow_low is None  # not requested
+
+    def test_submit_validates_inputs(self, engine, rng):
+        i1, i2 = _pair(rng)
+        with MicroBatchScheduler(engine, gather_window_s=0.0) as sched:
+            with pytest.raises(ValueError, match="one \\(H, W, 3\\)"):
+                sched.submit(i1[None], i2[None])
+            with pytest.raises(ValueError, match="shapes differ"):
+                sched.submit(i1, i2[:24])
+
+    def test_drain_on_close_serves_everything(self, engine, rng):
+        before = set(threading.enumerate())
+        sched = MicroBatchScheduler(engine, gather_window_s=0.01)
+        futs = [sched.submit(*_pair(rng)) for _ in range(5)]
+        sched.close(drain=True)
+        assert all(f.done() for f in futs)
+        for f in futs:
+            assert f.result().flow.shape == (32, 32, 2)
+        with pytest.raises(SchedulerClosed):
+            sched.submit(*_pair(rng))
+        sched.close()  # idempotent
+        assert not _no_leaked_workers(before)
+
+    def test_no_drain_close_fails_pending_loudly(self, engine, rng):
+        """A no-drain close must FAIL queued work, not strand it."""
+        faults.arm([{"site": "serve.request", "kind": "hang",
+                     "hang_s": 0.4}])
+        sched = MicroBatchScheduler(engine, gather_window_s=0.0)
+        first = sched.submit(*_pair(rng))   # dispatched, hangs 0.4s
+        time.sleep(0.2)
+        queued = sched.submit(*_pair(rng))  # still queued behind it
+        sched.close(drain=False)
+        # the dispatched request still completes — never abandoned
+        assert first.result(timeout=120).flow.shape == (32, 32, 2)
+        with pytest.raises(SchedulerClosed):
+            queued.result(timeout=120)
+
+
+class TestBackpressureAndDeadlines:
+    def test_full_queue_sheds_new_never_inflight(self, engine, rng):
+        faults.arm([{"site": "serve.request", "kind": "hang",
+                     "hang_s": 0.8}])
+        sched = MicroBatchScheduler(engine, max_queue=2,
+                                    gather_window_s=0.0)
+        accepted = [sched.submit(*_pair(rng))]
+        time.sleep(0.3)  # worker popped it and is hanging in dispatch
+        accepted += [sched.submit(*_pair(rng)) for _ in range(2)]
+        with pytest.raises(BackpressureError, match="queue full"):
+            sched.submit(*_pair(rng))
+        sched.close(drain=True)
+        # shedding rejected the NEW request only: every accepted one
+        # was served
+        for f in accepted:
+            assert f.result(timeout=0).flow.shape == (32, 32, 2)
+        snap = sched.metrics.snapshot()
+        assert snap["shed"] == 1
+        assert snap["abandoned_inflight"] == 0
+        assert snap["completed"] == 3
+
+    def test_queued_deadline_expires_inflight_completes(self, engine,
+                                                        rng):
+        """A deadline is enforced while QUEUED only: the dispatched
+        request outliving its deadline mid-device still completes;
+        the one expiring behind it fails fast."""
+        faults.arm([{"site": "serve.request", "kind": "hang",
+                     "hang_s": 0.6}])
+        sched = MicroBatchScheduler(engine, gather_window_s=0.0)
+        first = sched.submit(*_pair(rng), deadline_s=0.2)
+        time.sleep(0.25)  # first is mid-hang, its deadline now past
+        late = sched.submit(*_pair(rng), deadline_s=0.1)
+        assert first.result(timeout=120).flow.shape == (32, 32, 2)
+        with pytest.raises(DeadlineExceeded, match="never dispatched"):
+            late.result(timeout=120)
+        sched.close()
+        snap = sched.metrics.snapshot()
+        assert snap["deadline_missed"] == 1
+        assert snap["abandoned_inflight"] == 0
+
+
+class _UnservableShapeEngine:
+    """Duck-typed engine stub: capacity probes raise for one poisoned
+    spatial shape (what a mesh-invalid extent or compile failure looks
+    like), everything else serves a trivial flow — fast and
+    deterministic for the dispatcher-survival test."""
+
+    warm_start = False
+
+    def __init__(self):
+        self._compiled = {(2, 32, 32): object()}
+
+    def bucket_capacity(self, h, w):
+        if (h, w) == (24, 24):
+            raise RuntimeError("unservable shape (mesh extent)")
+        return 2
+
+    def route_bucket(self, b, h, w):
+        return (2, -(-h // 8) * 8, -(-w // 8) * 8)
+
+    def infer_batch(self, i1, i2):
+        return np.zeros(i1.shape[:3] + (2,), np.float32)
+
+
+class TestDispatcherResilience:
+    def test_unservable_shape_fails_its_requests_not_the_worker(self,
+                                                                rng):
+        """A shape whose capacity probe raises (mesh-invalid extent,
+        compile failure) must fail THOSE futures — not kill the
+        dispatcher thread and strand every queued request behind a
+        dead worker."""
+        sched = MicroBatchScheduler(_UnservableShapeEngine(),
+                                    gather_window_s=0.0)
+        bad = sched.submit(rng.rand(24, 24, 3).astype(np.float32),
+                           rng.rand(24, 24, 3).astype(np.float32))
+        with pytest.raises(RuntimeError, match="unservable shape"):
+            bad.result(timeout=30)
+        # the worker survived and keeps serving other shapes
+        ok = sched.submit(*_pair(rng))
+        assert ok.result(timeout=30).flow.shape == (32, 32, 2)
+        sched.close()
+        assert sched.metrics.snapshot()["failed"] == 1
+
+    def test_malformed_flow_init_fails_at_submit(self, engine, rng):
+        """A wrong-shape warm start is rejected at submit — at dispatch
+        the row assignment would fail (or, if broadcastable, silently
+        corrupt) the whole coalesced micro-batch, other callers
+        included."""
+        with MicroBatchScheduler(engine, gather_window_s=0.0) as sched:
+            with pytest.raises(ValueError, match="flow_init shape"):
+                sched.submit(*_pair(rng),
+                             flow_init=np.zeros((2,), np.float32))
+            with pytest.raises(ValueError, match="flow_init shape"):
+                # broadcastable-but-wrong must also be rejected
+                sched.submit(*_pair(rng),
+                             flow_init=np.zeros((1, 1, 2), np.float32))
+            ok = sched.submit(
+                *_pair(rng), flow_init=np.zeros((4, 4, 2), np.float32))
+            assert ok.result(timeout=120).flow.shape == (32, 32, 2)
+
+
+class TestServeRequestFaults:
+    def test_raise_fails_batch_not_worker(self, engine, rng):
+        faults.arm([{"site": "serve.request", "kind": "raise"}])
+        with MicroBatchScheduler(engine, gather_window_s=0.0) as sched:
+            bad = sched.submit(*_pair(rng))
+            with pytest.raises(faults.FaultInjected):
+                bad.result(timeout=120)
+            # the worker survived the injected failure
+            ok = sched.submit(*_pair(rng))
+            assert ok.result(timeout=120).flow.shape == (32, 32, 2)
+            snap = sched.metrics.snapshot()
+        assert snap["failed"] == 1 and snap["completed"] == 1
+
+    def test_hang_drill_no_leaked_threads(self, engine, rng):
+        """The satellite drill: a hung dispatch backs traffic up into
+        shed + deadline misses, and shutdown still drains clean with
+        no leaked worker threads (the PR-3 loader-semaphore lesson)."""
+        before = set(threading.enumerate())
+        faults.arm([{"site": "serve.request", "kind": "hang",
+                     "hang_s": 0.7}])
+        sched = MicroBatchScheduler(engine, max_queue=2,
+                                    gather_window_s=0.0)
+        futs = [sched.submit(*_pair(rng))]
+        time.sleep(0.2)  # the worker is now wedged mid-dispatch
+        futs.append(sched.submit(*_pair(rng), deadline_s=0.1))
+        futs.append(sched.submit(*_pair(rng)))
+        shed = 0
+        try:
+            sched.submit(*_pair(rng))
+        except BackpressureError:
+            shed = 1
+        sched.close(drain=True)
+        outcomes = {"served": 0, "missed": 0}
+        for f in futs:
+            try:
+                f.result(timeout=0)
+                outcomes["served"] += 1
+            except DeadlineExceeded:
+                outcomes["missed"] += 1
+        snap = sched.metrics.snapshot()
+        assert shed == 1 and snap["shed"] == 1
+        assert outcomes["missed"] == snap["deadline_missed"] == 1
+        assert outcomes["served"] == snap["completed"] == 2
+        assert snap["abandoned_inflight"] == 0
+        leaked = _no_leaked_workers(before)
+        assert not leaked, f"leaked scheduler threads: {leaked}"
+
+
+class TestVideoSessions:
+    def test_warm_start_recurrence(self, engine, rng):
+        frames = [rng.rand(32, 32, 3).astype(np.float32) * 255
+                  for _ in range(4)]
+        with MicroBatchScheduler(engine, gather_window_s=0.0) as sched:
+            sess = VideoSession(sched)
+            futs = [sess.submit_frame(f) for f in frames]
+            assert futs[0] is None and all(f is not None
+                                           for f in futs[1:])
+            results = [f.result(timeout=120) for f in futs[1:]]
+            assert sess.drain() is not None
+        assert all(r.flow.shape == (32, 32, 2) for r in results)
+        # a warm-start session asks for flow_low back on every pair
+        assert all(r.flow_low is not None for r in results)
+        assert all(r.flow_low.shape == (4, 4, 2) for r in results)
+        # pairs 2 and 3 can warm-start from the previous pair's flow;
+        # >= 1, not == 2: random-init weights produce flows that can
+        # blow out of the 4x4 low-res frame, and the session then
+        # (correctly) degrades that pair to a cold start
+        assert 1 <= sess.warm_submits <= 2
+
+    def test_flow_init_moves_the_refinement_start(self, engine, rng):
+        """The warm-start mechanism itself, deterministically: the same
+        pair with a nonzero flow_init differs from the cold dispatch
+        (same weights, same executable — the flow_init row is the only
+        difference)."""
+        i1, i2 = _pair(rng)
+        with MicroBatchScheduler(engine, gather_window_s=0.0) as sched:
+            cold = sched.submit(i1, i2).result(timeout=120).flow
+            warm = sched.submit(
+                i1, i2,
+                flow_init=np.full((4, 4, 2), 0.5, np.float32)).result(
+                timeout=120).flow
+        assert not np.array_equal(cold, warm)
+        assert np.isfinite(warm).all()
+
+    def test_shape_change_restarts_stream(self, engine, rng):
+        with MicroBatchScheduler(engine, gather_window_s=0.0) as sched:
+            sess = VideoSession(sched)
+            assert sess.submit_frame(
+                rng.rand(32, 32, 3).astype(np.float32)) is None
+            f1 = sess.submit_frame(
+                rng.rand(32, 32, 3).astype(np.float32))
+            assert f1.result(timeout=120).flow.shape == (32, 32, 2)
+            # resolution change: the pair is meaningless — restart
+            assert sess.submit_frame(
+                rng.rand(40, 40, 3).astype(np.float32)) is None
+            f2 = sess.submit_frame(
+                rng.rand(40, 40, 3).astype(np.float32))
+            # first pair of the restarted stream is a cold start
+            assert sess.warm_submits == 0
+            assert f2.result(timeout=120).flow.shape == (40, 40, 2)
+
+    def test_blown_out_warm_start_cold_restarts(self, engine, rng):
+        """Found by the verification drive: when the previous pair's
+        flow is larger than the frame, every forward-warped point
+        lands outside it — griddata has an empty scatter and returns
+        NaN ('nearest' ignores fill_value), which would poison the
+        stream. The session must cold-restart instead."""
+        with MicroBatchScheduler(engine, gather_window_s=0.0) as sched:
+            sess = VideoSession(sched)
+            sess.submit_frame(rng.rand(32, 32, 3).astype(np.float32))
+            # the degenerate state: all motion out of the 4x4 low-res
+            # frame (what random weights / a garbage pair produce)
+            sess._flow_low = np.full((4, 4, 2), 99.0, np.float32)
+            fut = sess.submit_frame(
+                rng.rand(32, 32, 3).astype(np.float32))
+            res = fut.result(timeout=120)
+            assert np.isfinite(res.flow).all()
+            assert sess.warm_submits == 0  # degraded to a cold start
+        # the scheduler rejects a caller's non-finite warm start with a
+        # cause instead of returning NaN flow from the device
+        with MicroBatchScheduler(engine, gather_window_s=0.0) as sched:
+            bad = np.full((4, 4, 2), np.nan, np.float32)
+            with pytest.raises(ValueError, match="non-finite"):
+                sched.submit(*_pair(rng), flow_init=bad)
+
+    def test_failed_pair_cold_restarts_not_poisons(self, engine, rng):
+        """A deadline-missed pair surfaces on ITS future; the session
+        cold-restarts the recurrence instead of dying on harvest."""
+        faults.arm([{"site": "serve.request", "kind": "hang",
+                     "hang_s": 0.5}])
+        with MicroBatchScheduler(engine, gather_window_s=0.0) as sched:
+            blocker = sched.submit(*_pair(rng))  # wedges the worker
+            time.sleep(0.2)
+            sess = VideoSession(sched)
+            sess.submit_frame(rng.rand(32, 32, 3).astype(np.float32))
+            doomed = sess.submit_frame(
+                rng.rand(32, 32, 3).astype(np.float32), deadline_s=0.05)
+            ok = sess.submit_frame(
+                rng.rand(32, 32, 3).astype(np.float32))
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=120)
+            assert ok.result(timeout=120).flow.shape == (32, 32, 2)
+            assert sess.warm_submits == 0  # cold restart, not stale warm
+            blocker.result(timeout=120)
+
+
+class TestServingMetricsUnit:
+    def test_histogram_ladder_and_percentiles(self):
+        from raft_tpu.serving.metrics import LatencyHistogram
+
+        h = LatencyHistogram()
+        for v in (0.05, 1.0, 3.0, 40.0, 70000.0):
+            h.observe(v)
+        s = h.snapshot()
+        assert s["count"] == 5
+        assert s["max_ms"] == 70000.0
+        assert s["p50_ms"] <= s["p99_ms"] <= s["max_ms"]
+        assert sum(s["counts"]) == 5
+        assert h.quantile(0.0) > 0
+
+    def test_snapshot_shape_and_jsonl_append(self, tmp_path):
+        from raft_tpu.serving.metrics import ServingMetrics
+
+        path = str(tmp_path / "m" / "metrics.jsonl")
+        m = ServingMetrics(path)
+        m.record_submit(depth=1)
+        m.record_submit(depth=2)
+        m.record_dispatch("3x32x32", filled=2, capacity=3, depth=0)
+        m.record_complete("3x32x32", queue_ms=1.0, device_ms=2.0)
+        m.record_complete("3x32x32", queue_ms=4.0, device_ms=2.0)
+        m.record_shed()
+        rec = m.write_snapshot(executables=1)
+        again = m.write_snapshot(executables=1)
+        lines = [json.loads(line) for line in open(path)]
+        assert [r["step"] for r in lines] == [1, 2]
+        assert rec["submitted"] == 2 and rec["shed"] == 1
+        assert rec["queue_depth"]["max"] == 2
+        b = rec["buckets"]["3x32x32"]
+        assert b["occupancy"] == round(2 / 3, 4)
+        assert b["total"]["count"] == 2
+        assert rec["occupancy"]["mean"] > \
+            rec["occupancy"]["one_per_dispatch_baseline"]
+        assert again["step"] == 2
+
+    def test_write_without_path_raises(self):
+        from raft_tpu.serving.metrics import ServingMetrics
+
+        with pytest.raises(ValueError, match="no metrics path"):
+            ServingMetrics().write_snapshot()

@@ -1,8 +1,10 @@
 """Serving tests: AOT engine shape routing, StableHLO export round-trip,
 video writer — the backend-parity discipline of test_trt.py:52-99 applied
-to our export path."""
+to our export path. (The scheduler layer above the engine has its own
+suite, tests/test_scheduler.py.)"""
 
 import os
+import threading
 
 import numpy as np
 import pytest
@@ -86,6 +88,47 @@ class TestEngine:
 
         with pytest.raises(ValueError, match="pytree definition"):
             eng.update_weights(freeze(variables))
+
+    def test_threaded_swap_never_mixes_a_dispatch(self, small_setup, rng):
+        """The live-swap race regression: update_weights hammering from
+        another thread while infer_batch dispatches must yield outputs
+        that match pure-old or pure-new weights EXACTLY — never a
+        mixture (the engine snapshots its weight tree once per dispatch
+        under its lock; a swap lands between dispatches)."""
+        cfg, variables = small_setup
+        eng = RAFTEngine(variables, cfg, iters=1, envelope=[(1, 64, 64)])
+        scaled = jax.tree_util.tree_map(lambda p: p * 1.5, variables)
+        img1 = rng.rand(1, 64, 64, 3).astype(np.float32) * 255
+        img2 = rng.rand(1, 64, 64, 3).astype(np.float32) * 255
+        ref_a = eng.infer_batch(img1, img2)
+        eng.update_weights(scaled)
+        ref_b = eng.infer_batch(img1, img2)
+        eng.update_weights(variables)
+
+        stop = threading.Event()
+
+        def swapper():
+            flip = False
+            while not stop.is_set():
+                eng.update_weights(scaled if flip else variables)
+                flip = not flip
+
+        th = threading.Thread(target=swapper, name="swap-churn")
+        th.start()
+        try:
+            for _ in range(12):
+                out = eng.infer_batch(img1, img2)
+                da = np.abs(out - ref_a).max()
+                db = np.abs(out - ref_b).max()
+                # same executable + same weight tree is deterministic
+                # on CPU: a mixed dispatch shows as BOTH distances
+                # being large
+                assert min(da, db) < 1e-5, (
+                    f"dispatch mixed old/new weights (d_old={da}, "
+                    f"d_new={db})")
+        finally:
+            stop.set()
+            th.join()
 
     def test_exact_shapes_mode_matches_plain_jit_bitwise(self, small_setup,
                                                          rng):
@@ -221,6 +264,31 @@ class TestMeshServing:
         eng = RAFTEngine(variables, cfg, iters=1, envelope=[(2, 64, 64)],
                          mesh=mesh)
         assert (2, 64, 64) in eng._compiled
+
+    def test_warm_start_mesh_engine_flow_low_roundtrip(self, small_setup,
+                                                       rng):
+        """warm_start under a mesh: the 1/8-res flow_init input shards
+        with the same batch+spatial spec (bucket h % 8*spatial == 0
+        makes h/8 divide the axis), the returned flow_low feeds back as
+        the next call's warm start, and warm + cold calls share ONE
+        executable (zero flow_init IS cold start)."""
+        from raft_tpu.parallel.mesh import make_mesh
+
+        cfg, variables = small_setup
+        eng = RAFTEngine(variables, cfg, iters=1, envelope=[],
+                         mesh=make_mesh(4, spatial=2), warm_start=True)
+        img1 = rng.rand(2, 64, 64, 3).astype(np.float32) * 255
+        img2 = rng.rand(2, 64, 64, 3).astype(np.float32) * 255
+        flow, low = eng.infer_batch(img1, img2, return_low=True)
+        assert flow.shape == (2, 64, 64, 2) and low.shape == (2, 8, 8, 2)
+        warm = eng.infer_batch(img1, img2, flow_init=low)
+        assert sorted(eng._compiled) == [(2, 64, 64)]
+        assert not np.array_equal(flow, warm)  # the start point moved
+
+        # engine-direct contract: a cold engine rejects the warm args
+        cold = RAFTEngine(variables, cfg, iters=1, envelope=[])
+        with pytest.raises(ValueError, match="warm_start"):
+            cold.infer_batch(img1, img2, return_low=True)
 
     def test_sharded_engine_rejects_thin_spatial_shards(self, small_setup,
                                                        rng):

@@ -168,6 +168,53 @@ def _build_engine_bucketed():
     return build
 
 
+def _build_scheduler_coalesce():
+    def build():
+        ensure_cpu()
+        import threading
+
+        import numpy as np
+
+        from raft_tpu.serving.engine import RAFTEngine
+        from raft_tpu.serving.scheduler import MicroBatchScheduler
+
+        variables, cfg = _engine_weights()
+        h, w = _IMAGE_HW
+        # warm-start engine (flow_init input, flow_low output): the
+        # serving front-end's deployed configuration — its bucket
+        # executable is a DIFFERENT program than the plain canaries'
+        eng = RAFTEngine(variables, cfg, iters=_ITERS,
+                         envelope=[(2, h, w)], precompile=True,
+                         warm_start=True)
+        results = []
+        with MicroBatchScheduler(eng, max_batch=2,
+                                 gather_window_s=0.05) as sched:
+            def caller(seed):
+                rng = np.random.RandomState(seed)
+                futs = [sched.submit(
+                    rng.rand(h, w, 3).astype(np.float32) * 255,
+                    rng.rand(h, w, 3).astype(np.float32) * 255)
+                    for _ in range(3)]
+                results.extend(f.result(timeout=600) for f in futs)
+
+            threads = [threading.Thread(target=caller, args=(s,))
+                       for s in (0, 1)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert len(results) == 6, "scheduler dropped requests"
+        texts = tuple(exe.as_text()
+                      for exe in eng._compiled.values() if exe)
+        return CanaryResult(
+            observed_compiles=len(eng._compiled),
+            detail=f"micro-batch scheduler, 2 submitters x 3 requests "
+                   f"at {h}x{w} (ragged vs the (2,{h},{w}) bucket), "
+                   "warm-start engine",
+            hlo_texts=texts)
+    return build
+
+
 def build_targets() -> List[Target]:
     return [
         Target(
@@ -204,4 +251,15 @@ def build_targets() -> List[Target]:
             build=_build_engine_bucketed(),
             expect_compiles=1,
             notes="envelope routing pads up instead of recompiling"),
+        Target(
+            name="scheduler_coalesce",
+            kind="canary",
+            build=_build_scheduler_coalesce(),
+            expect_compiles=1,     # one bucket, cross-caller filled —
+                                   # pinned in tests/test_scheduler.py;
+                                   # this mechanizes it for the artifact
+                                   # tier (the PR-2 ragged-tail lesson,
+                                   # one layer up)
+            notes="async micro-batching front-end coalesces two "
+                  "callers' ragged traffic into the documented bucket"),
     ]

@@ -158,6 +158,18 @@ else
     commit_msmt "r6 ladder rows" ONCHIP_r06.log
 fi
 
+# ---- serving front-end: scheduler ragged-traffic drill (PR 6) --------
+# two concurrent submitters + two warm-start sessions over cvt2trt-ish
+# shapes with ragged per-shape totals; the JSON line records occupancy
+# vs the one-request-per-dispatch baseline and real-hardware latency
+# histograms (the CPU tier-1 drill proves the ROUTING — executable
+# count == documented buckets — but its latency numbers mean nothing).
+# The metrics.jsonl snapshot lands in $OUT's dir for the PROFILE entry.
+step serve_bench_r6 1800 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 48 --submitters 2 \
+    --bucket-batch 4 --sessions 2 --session-frames 4 \
+    --deadline-ms 30000 --gather-ms 20 --log-dir /tmp/raft_serve_r6
+
 # ---- trace the loser's question: where did the fused step's time go ---
 # (only worth a window slot once both A/B rungs have numbers)
 if [ -e "$MARK/bench_g_gruxla" ] && [ -e "$MARK/bench_g_grufused" ]; then
