@@ -12,6 +12,17 @@ batch-fill into the SAME executables the full micro-batches used.
 Run on the real chip (cvt2trt-ish shapes):
     python -m raft_tpu.cli.serve_bench --shapes 440x1024,368x496 \\
         --requests 48 --submitters 2 --bucket-batch 4
+
+``--chaos N`` instead runs N rounds of randomized fault plans
+(raise/hang at ``serve.request`` / ``serve.dispatch_exec`` /
+``engine.compile``, seeded probabilities and nth-call scoping) through
+the full resilience stack — dispatch watchdog, per-bucket breakers,
+engine drop + recompile — and asserts the drill invariants after every
+round: every accepted future settled (zero stranded), the accounting
+identity submitted == completed + failed + deadline_missed + cancelled,
+abandoned_inflight == 0, and health() consistent with the breaker
+board. A final fault-free round proves recovery: health back to
+healthy and the executable count back at the documented bucket count.
 """
 
 from __future__ import annotations
@@ -19,28 +30,70 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import threading
 import time
+from concurrent.futures import wait as futures_wait
 
 
 def _ceil8(x: int) -> int:
     return -(-x // 8) * 8
 
 
+#: the chaos sites the randomized plans draw from — the serving path's
+#: three distinct hang/failure surfaces (device call, executor worker,
+#: XLA compile)
+CHAOS_SITES = ("serve.request", "serve.dispatch_exec", "engine.compile")
+
+
+def chaos_plan(rng: random.Random, hang_s: float = 0.5) -> dict:
+    """One randomized-but-deterministic fault plan: per site, maybe an
+    entry with randomized kind (raise/hang), first eligible occurrence
+    (``at``), fire budget (``count``) and per-call probability
+    (``p``). ``crash`` is deliberately excluded here — an in-process
+    drill can't assert anything after ``os._exit``; the crash class is
+    drilled via a subprocess (tests/chaos_serve_worker.py) and by the
+    PR-3 supervisor layer."""
+    faults = []
+    for site in CHAOS_SITES:
+        if rng.random() < 0.25:
+            continue  # site spared this round
+        faults.append({
+            "site": site,
+            "kind": "hang" if rng.random() < 0.4 else "raise",
+            "at": rng.randint(1, 3),
+            "count": rng.randint(1, 3),
+            "p": round(rng.uniform(0.3, 0.9), 3),
+            "hang_s": hang_s,
+        })
+    return {"seed": rng.randrange(1 << 16), "faults": faults}
+
+
 def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
               bucket_batch=4, iters=2, sessions=0, session_frames=4,
               deadline_s=None, max_queue=64, gather_window_s=0.005,
+              dispatch_timeout_s=None, breaker_failures=0,
+              breaker_backoff_s=0.25, breaker_backoff_max_s=30.0,
+              fault_plan=None, recover_s=0.0,
               metrics_path=None, seed=0, engine=None):
     """The drill as a library call (tests reuse it, and may pass a
     prebuilt warm-start ``engine`` to share compiles across drills).
-    Returns the summary dict the CLI prints."""
+    Returns the summary dict the CLI prints.
+
+    ``fault_plan`` arms the fault harness for this drill only (disarmed
+    in a finally). ``recover_s`` > 0 runs a post-traffic recovery
+    phase: per shape, retry probes until one serves or the budget runs
+    out — the half-open probe path that closes an opened breaker and
+    lazily recompiles a dropped bucket."""
     import numpy as np
 
     from raft_tpu.serving.engine import RAFTEngine
+    from raft_tpu.serving.resilience import CircuitOpen, DispatchWedged
     from raft_tpu.serving.scheduler import (BackpressureError,
                                             DeadlineExceeded,
                                             MicroBatchScheduler)
     from raft_tpu.serving.session import VideoSession
+    from raft_tpu.testing import faults
 
     if engine is None:
         # one documented bucket per distinct ÷8-padded request shape
@@ -53,10 +106,17 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     sched = MicroBatchScheduler(engine, max_queue=max_queue,
                                 max_batch=bucket_batch,
                                 gather_window_s=gather_window_s,
+                                dispatch_timeout_s=dispatch_timeout_s,
+                                breaker_failures=breaker_failures,
+                                breaker_backoff_s=breaker_backoff_s,
+                                breaker_backoff_max_s=breaker_backoff_max_s,
+                                breaker_rng=random.Random(seed),
                                 metrics_path=metrics_path)
     futures = [[] for _ in range(submitters)]
     shed = [0] * submitters
+    rejected = [0] * submitters
     session_stats = {"pairs": 0, "warm": 0, "errors": 0}
+    recovery = {"probes": 0, "recovered": 0}
 
     def submit_loop(sid):
         rng = np.random.RandomState(seed + sid)
@@ -71,14 +131,20 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                     sched.submit(i1, i2, deadline_s=deadline_s))
             except BackpressureError:
                 shed[sid] += 1
+            except CircuitOpen:
+                rejected[sid] += 1
 
     def session_loop(sid):
         rng = np.random.RandomState(seed + 1000 + sid)
         h, w = shapes[sid % len(shapes)]
         sess = VideoSession(sched, deadline_s=deadline_s)
-        futs = [sess.submit_frame(rng.rand(h, w, 3).astype(np.float32)
-                                  * 255)
-                for _ in range(session_frames + 1)]
+        futs = []
+        for _ in range(session_frames + 1):
+            try:
+                futs.append(sess.submit_frame(
+                    rng.rand(h, w, 3).astype(np.float32) * 255))
+            except (BackpressureError, CircuitOpen):
+                session_stats["errors"] += 1
         for f in futs:
             if f is None:
                 continue
@@ -89,37 +155,87 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                 session_stats["errors"] += 1
         session_stats["warm"] += sess.warm_submits
 
+    def recover_loop():
+        """Per shape: probe until one request serves (the breaker's
+        half-open round-trip + the dropped bucket's lazy recompile) or
+        the budget expires."""
+        rng = np.random.RandomState(seed + 5000)
+        for h, w in shapes:
+            t_end = time.monotonic() + recover_s
+            while time.monotonic() < t_end:
+                try:
+                    fut = sched.submit(
+                        rng.rand(h, w, 3).astype(np.float32) * 255,
+                        rng.rand(h, w, 3).astype(np.float32) * 255)
+                    recovery["probes"] += 1
+                    fut.result(timeout=max(recover_s, 30.0))
+                    recovery["recovered"] += 1
+                    break
+                except Exception:
+                    time.sleep(0.05)
+
     threads = ([threading.Thread(target=submit_loop, args=(s,))
                 for s in range(submitters)]
                + [threading.Thread(target=session_loop, args=(s,))
                   for s in range(sessions)])
+    if fault_plan is not None:
+        faults.arm(fault_plan)
     t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    sched.close(drain=True)          # finishes every accepted request
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if recover_s > 0:
+            recover_loop()
+        # settle traffic before reading health: submit threads join as
+        # soon as the queue has everything, and a health snapshot taken
+        # mid-dispatch would report the PRE-outcome state (a wedge that
+        # hasn't happened yet reads healthy — observed on a live drive)
+        futures_wait([f for fl in futures for f in fl], timeout=600)
+        health = sched.health()         # before close: live liveness
+        sched.close(drain=True)         # settles every accepted request
+    finally:
+        if fault_plan is not None:
+            faults.disarm()
     wall = time.perf_counter() - t0
 
-    served = deadline_missed = errors = 0
+    served = deadline_missed = wedged = circuit = errors = stranded = 0
     for fl in futures:
         for fut in fl:
+            if not fut.done():
+                stranded += 1   # close(drain=True) settles everything:
+                continue        # nonzero == a stranded-future bug
             try:
-                fut.result(timeout=0)  # close() drained: all settled
+                fut.result(timeout=0)
                 served += 1
             except DeadlineExceeded:
                 deadline_missed += 1
+            except DispatchWedged:
+                wedged += 1
+            except CircuitOpen:
+                circuit += 1
             except Exception:
                 errors += 1
     rec = sched.metrics.snapshot(executables=len(engine._compiled))
     total_served = served + session_stats["pairs"]
     occ = rec["occupancy"]
+    accounted = (rec["completed"] + rec["failed"]
+                 + rec["deadline_missed"] + rec["cancelled"])
+    open_buckets = sum(1 for b in health["buckets"].values()
+                       if b["state"] != "closed")
     return {
         "submitted": rec["submitted"],
+        "accepted": sum(len(fl) for fl in futures),
         "served": served,
         "shed": sum(shed),
+        "circuit_rejected": sum(rejected),
         "deadline_missed": deadline_missed,
         "errors": errors + session_stats["errors"],
+        "failed_wedged": wedged,
+        "failed_circuit": circuit,
+        "stranded": stranded,
+        "accounting_ok": rec["submitted"] == accounted,
         "abandoned_inflight": rec["abandoned_inflight"],
         "dispatches": rec["dispatches"],
         "executables": len(engine._compiled),
@@ -128,10 +244,125 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
         "baseline_occupancy": occ["one_per_dispatch_baseline"],
         "session_pairs": session_stats["pairs"],
         "warm_submits": session_stats["warm"],
+        "recovery_probes": recovery["probes"],
+        "recovered_shapes": recovery["recovered"],
+        "health_state": health["state"],
+        "open_buckets": open_buckets,
+        "wedged_dispatches": rec["resilience"]["wedged"],
+        "quarantined_threads": rec["resilience"]["quarantined_threads"],
+        "breaker_transitions": rec["resilience"]["breaker_transitions"],
         "p50_ms": rec["latency"]["p50_ms"],
         "p99_ms": rec["latency"]["p99_ms"],
         "wall_s": round(wall, 3),
         "pairs_per_s": round(total_served / wall, 2) if wall else 0.0,
+    }
+
+
+def _round_violations(s: dict) -> list:
+    """The chaos-drill invariants, checked after every round."""
+    v = []
+    if s["stranded"]:
+        v.append(f"stranded futures: {s['stranded']}")
+    if not s["accounting_ok"]:
+        v.append("submitted != completed+failed+deadline_missed"
+                 "+cancelled")
+    if s["abandoned_inflight"]:
+        v.append(f"abandoned_inflight: {s['abandoned_inflight']}")
+    # injected FaultInjected raises land in "errors": settled futures,
+    # accounted — not a violation, the drill injected them on purpose
+    if s["health_state"] == "healthy" and s["open_buckets"]:
+        v.append("health says healthy with open breakers")
+    if s["health_state"] == "degraded" and not s["open_buckets"]:
+        v.append("health says degraded with all breakers closed")
+    return v
+
+
+def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
+                    submitters=2, bucket_batch=3, iters=1,
+                    dispatch_timeout_s=0.4, hang_s=0.8,
+                    breaker_failures=2, breaker_backoff_s=0.15,
+                    breaker_backoff_max_s=0.6, recover_s=8.0,
+                    gather_window_s=0.0, max_queue=64,
+                    deadline_s=None, seed=0, metrics_path=None,
+                    engine=None):
+    """``rounds`` randomized fault rounds + one clean recovery round
+    over ONE shared engine (dropped buckets recompile lazily across
+    rounds), asserting the invariants after each. Returns the summary
+    dict; ``violations`` is empty iff every invariant held.
+
+    The engine compiles ``exact_shapes=True`` so recovery is honest:
+    a dropped bucket must recompile (it can't hide behind a spatially
+    larger healthy bucket), pinning the documented executable count
+    after the final clean round."""
+    from raft_tpu.serving.engine import RAFTEngine
+
+    rng = random.Random(seed)
+    if engine is None:
+        envelope = sorted({(bucket_batch, _ceil8(h), _ceil8(w))
+                           for h, w in shapes})
+        engine = RAFTEngine(variables, cfg, iters=iters,
+                            envelope=envelope, precompile=True,
+                            warm_start=True, exact_shapes=True)
+    documented = len(engine._compiled)
+    per_round = []
+    violations = []
+    common = dict(shapes=shapes, requests=requests,
+                  submitters=submitters, bucket_batch=bucket_batch,
+                  iters=iters, deadline_s=deadline_s,
+                  max_queue=max_queue, gather_window_s=gather_window_s,
+                  dispatch_timeout_s=dispatch_timeout_s,
+                  breaker_failures=breaker_failures,
+                  breaker_backoff_s=breaker_backoff_s,
+                  breaker_backoff_max_s=breaker_backoff_max_s,
+                  recover_s=recover_s, metrics_path=metrics_path,
+                  engine=engine)
+    for r in range(rounds):
+        plan = chaos_plan(rng, hang_s=hang_s)
+        s = run_drill(variables, cfg, seed=seed + 17 * r,
+                      fault_plan=plan, **common)
+        s["round"] = r
+        s["plan"] = plan
+        per_round.append(s)
+        violations += [f"round {r}: {v}" for v in _round_violations(s)]
+    # the clean round: no faults — recovery must complete (health back
+    # to healthy, every shape serving, executables at the documented
+    # bucket count with no leaked duplicates from wedged recompiles).
+    # The watchdog runs at a production-sized timeout here: the chaos
+    # rounds' deliberately short deadline would verdict a legitimate
+    # multi-second recompile of a dropped bucket as a wedge (the drill
+    # self-heals — the quarantined thread's compile still lands via
+    # first-insert-wins — but the round's traffic would fail), and the
+    # clean round must prove full recovery, not re-inject noise
+    clean = dict(common, dispatch_timeout_s=max(30.0,
+                                                dispatch_timeout_s))
+    s = run_drill(variables, cfg, seed=seed + 999, fault_plan=None,
+                  **clean)
+    s["round"] = "clean"
+    per_round.append(s)
+    violations += [f"clean round: {v}" for v in _round_violations(s)]
+    if s["health_state"] != "healthy":
+        violations.append(
+            f"clean round: health {s['health_state']} != healthy")
+    if s["served"] != s["accepted"]:
+        violations.append("clean round: served != accepted traffic")
+    if len(engine._compiled) != documented:
+        violations.append(
+            f"executables {len(engine._compiled)} != documented "
+            f"{documented} after recovery (leaked/lost bucket)")
+    totals = {k: sum(p[k] for p in per_round) for k in
+              ("submitted", "served", "shed", "circuit_rejected",
+               "deadline_missed", "failed_wedged", "failed_circuit",
+               "errors", "wedged_dispatches", "quarantined_threads")}
+    transitions = {k: sum(p["breaker_transitions"][k] for p in per_round)
+                   for k in ("open", "half_open", "closed")}
+    return {
+        "chaos_rounds": rounds,
+        "violations": violations,
+        "documented_buckets": documented,
+        "executables": len(engine._compiled),
+        "breaker_transitions": transitions,
+        "totals": totals,
+        "per_round": per_round,
     }
 
 
@@ -158,6 +389,30 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=20,
                    help="refinement iterations (export bakes 20)")
     p.add_argument("--small", action="store_true")
+    p.add_argument("--chaos", type=int, default=0, metavar="N",
+                   help="run N randomized fault rounds + a clean "
+                        "recovery round through the resilience stack "
+                        "and assert the drill invariants (exit 1 on "
+                        "any violation)")
+    p.add_argument("--dispatch-timeout-ms", type=float, default=0,
+                   help="dispatch watchdog deadline (0: off; --chaos "
+                        "default 400ms)")
+    p.add_argument("--breaker-failures", type=int, default=0,
+                   help="consecutive failures opening a bucket's "
+                        "breaker (0: off; --chaos default 2)")
+    p.add_argument("--breaker-backoff-ms", type=float, default=250.0)
+    p.add_argument("--breaker-backoff-max-ms", type=float,
+                   default=30000.0,
+                   help="backoff ceiling; size it ABOVE a real "
+                        "recompile or half-open probes churn against "
+                        "a bucket that can't come back yet")
+    p.add_argument("--hang-ms", type=float, default=800.0,
+                   help="injected hang length for --chaos plans (must "
+                        "exceed the dispatch timeout to wedge)")
+    p.add_argument("--recover-s", type=float, default=0.0,
+                   help="per-shape recovery-probe budget after "
+                        "traffic (drives the half-open probe; --chaos "
+                        "default 8s)")
     p.add_argument("--log-dir", default=None,
                    help="append the metrics snapshot to "
                         "<log-dir>/metrics.jsonl")
@@ -179,6 +434,28 @@ def main(argv=None):
     variables = model.init(jax.random.PRNGKey(0), tiny, tiny, iters=1)
     metrics_path = (os.path.join(args.log_dir, "metrics.jsonl")
                     if args.log_dir else None)
+    if args.chaos:
+        summary = run_chaos_drill(
+            variables, cfg, shapes=shapes, rounds=args.chaos,
+            requests=args.requests, submitters=args.submitters,
+            bucket_batch=args.bucket_batch, iters=args.iters,
+            dispatch_timeout_s=(args.dispatch_timeout_ms / 1e3
+                                if args.dispatch_timeout_ms else 0.4),
+            hang_s=args.hang_ms / 1e3,
+            breaker_failures=args.breaker_failures or 2,
+            breaker_backoff_s=args.breaker_backoff_ms / 1e3,
+            breaker_backoff_max_s=max(args.breaker_backoff_max_ms,
+                                      args.breaker_backoff_ms) / 1e3,
+            recover_s=args.recover_s or 8.0,
+            gather_window_s=args.gather_ms / 1e3,
+            deadline_s=(args.deadline_ms / 1e3 if args.deadline_ms
+                        else None),
+            max_queue=args.queue, seed=args.seed,
+            metrics_path=metrics_path)
+        print(json.dumps(summary), flush=True)
+        if summary["violations"]:
+            raise SystemExit(1)
+        return
     summary = run_drill(
         variables, cfg, shapes=shapes, requests=args.requests,
         submitters=args.submitters, bucket_batch=args.bucket_batch,
@@ -186,6 +463,13 @@ def main(argv=None):
         session_frames=args.session_frames,
         deadline_s=args.deadline_ms / 1e3 if args.deadline_ms else None,
         max_queue=args.queue, gather_window_s=args.gather_ms / 1e3,
+        dispatch_timeout_s=(args.dispatch_timeout_ms / 1e3
+                            if args.dispatch_timeout_ms else None),
+        breaker_failures=args.breaker_failures,
+        breaker_backoff_s=args.breaker_backoff_ms / 1e3,
+        breaker_backoff_max_s=max(args.breaker_backoff_max_ms,
+                                  args.breaker_backoff_ms) / 1e3,
+        recover_s=args.recover_s,
         metrics_path=metrics_path, seed=args.seed)
     print(json.dumps(summary), flush=True)
 

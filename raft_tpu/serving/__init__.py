@@ -9,11 +9,16 @@ and the video/batch helpers (``video.py`` = raft_trt_utils.py analog).
 Above the engine sits the serving front-end the reference never had:
 an async micro-batching scheduler with deadlines and backpressure
 (``scheduler.py``), per-stream warm-start video sessions
-(``session.py``), and the serving metrics surface (``metrics.py``).
+(``session.py``), the serving metrics surface (``metrics.py``), and
+the resilience layer (``resilience.py``): dispatch watchdog with
+quarantine-and-replace, per-bucket circuit breakers, engine recovery,
+and the ``health()`` surface.
 """
 
 from raft_tpu.serving.engine import SHAPE_ENVELOPE_LINUX, RAFTEngine
 from raft_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from raft_tpu.serving.resilience import (CircuitBreaker, CircuitOpen,
+                                         DispatchExecutor, DispatchWedged)
 from raft_tpu.serving.scheduler import (BackpressureError, DeadlineExceeded,
                                         MicroBatchScheduler, SchedulerClosed,
                                         ServeResult)
@@ -22,4 +27,5 @@ from raft_tpu.serving.session import VideoSession
 __all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX", "MicroBatchScheduler",
            "BackpressureError", "DeadlineExceeded", "SchedulerClosed",
            "ServeResult", "VideoSession", "ServingMetrics",
-           "LatencyHistogram"]
+           "LatencyHistogram", "CircuitBreaker", "CircuitOpen",
+           "DispatchExecutor", "DispatchWedged"]

@@ -26,6 +26,7 @@ import numpy as np
 from raft_tpu.config import ITERS_EXPORT, RAFTConfig
 from raft_tpu.models import RAFT
 from raft_tpu.ops.padding import pad_amounts
+from raft_tpu.testing.faults import fault_point
 
 # cvt2trt.sh:1 envelope (min 1x3x256x256 / opt 2x3x800x800 / max 8x3x1024x1024)
 SHAPE_ENVELOPE_LINUX: List[Tuple[int, int, int]] = [
@@ -221,6 +222,11 @@ class RAFTEngine:
         # avals only, so compiling against a stale snapshot is fine;
         # racing threads at worst duplicate one compile and the first
         # insert wins.
+        # chaos site (real compiles only — cache hits return above):
+        # "raise" models an uncompilable shape, "hang" a compile that
+        # never returns — the wedge the scheduler's dispatch watchdog
+        # must survive
+        fault_point("engine.compile")
         exe = self._fn.lower(*args).compile()
         with self._lock:
             # first compile wins a race; a precompile=False placeholder
@@ -299,6 +305,18 @@ class RAFTEngine:
                 fits = [s[0] for s in self._compiled
                         if s[1] >= hp and s[2] >= wp]
         return max(fits) if fits else None
+
+    def drop_bucket(self, shape: Tuple[int, int, int]) -> bool:
+        """Forget one compiled bucket executable (serving resilience:
+        a dispatch-wedge verdict indicts the executable that hung —
+        the scheduler drops it here and the breaker's half-open probe
+        lazily recompiles via ``ensure_bucket``/compile-on-miss).
+        Returns True when the bucket was present. ``precompile=False``
+        placeholders count as present — the key is removed either way
+        so the recompile starts clean."""
+        missing = object()
+        with self._lock:
+            return self._compiled.pop(shape, missing) is not missing
 
     def ensure_bucket(self, batch: int, h: int, w: int
                       ) -> Tuple[int, int, int]:

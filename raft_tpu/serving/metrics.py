@@ -20,7 +20,9 @@ import bisect
 import json
 import math
 import os
+import sys
 import threading
+import time
 from typing import Dict, List, Optional
 
 #: 1-2-5 log ladder, 0.1 ms .. 60 s — everything from a warm CPU
@@ -108,6 +110,13 @@ class ServingMetrics:
         self._depth_sum = 0
         self._depth_samples = 0
         self._snapshots = 0
+        # resilience surface (serving/resilience.py): wedge verdicts,
+        # quarantined (leaked) dispatch threads, breaker activity
+        self.wedged = 0
+        self.quarantined_threads = 0
+        self.circuit_rejected = 0
+        self.breaker_transitions = {"open": 0, "half_open": 0,
+                                    "closed": 0}
 
     # -- recording --------------------------------------------------------
 
@@ -171,6 +180,67 @@ class ServingMetrics:
         with self._lock:
             self.failed += n
 
+    # -- resilience events ------------------------------------------------
+
+    def record_event(self, event: str, **fields) -> None:
+        """Append one event record to metrics.jsonl — the supervisor's
+        restart-event format (training/supervisor.py), so the dashboard
+        tailing one file sees serving health transitions next to
+        trainer restarts. No-op without a path; a failed append is
+        logged and swallowed (observability must never take down
+        serving)."""
+        if self.path is None:
+            return
+        rec = {"event": event, "time": time.time(),
+               "kind": "serving_event", **fields}
+        try:
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(rec) + "\n")
+        except OSError as exc:
+            print(f"[serving-metrics] event append failed ({exc}) — "
+                  "continuing", file=sys.stderr, flush=True)
+
+    def record_wedge(self, bucket: str, failed: int,
+                     timeout_s: float) -> None:
+        """A dispatch wedge verdict: ``failed`` futures were failed
+        with DispatchWedged after ``timeout_s``."""
+        with self._lock:
+            self.wedged += 1
+            self.failed += failed
+        self.record_event("dispatch_wedged", bucket=bucket,
+                          failed=failed, timeout_s=timeout_s)
+
+    def record_quarantined(self, bucket: str, alive: int) -> None:
+        """A stuck dispatch thread was quarantined and replaced;
+        ``alive`` is how many quarantined threads still live — the
+        leak, recorded rather than hidden."""
+        with self._lock:
+            self.quarantined_threads += 1
+        self.record_event("thread_quarantined", bucket=bucket,
+                          alive=alive)
+
+    def record_breaker_transition(self, bucket: str, old: str,
+                                  new: str) -> None:
+        with self._lock:
+            if new in self.breaker_transitions:
+                self.breaker_transitions[new] += 1
+        self.record_event("breaker_" + new, bucket=bucket,
+                          previous=old)
+
+    def record_state_change(self, old: str, new: str,
+                            reason: str) -> None:
+        """Scheduler health-state transition (healthy|degraded|wedged)."""
+        self.record_event("serving_state", state=new, previous=old,
+                          reason=reason)
+
+    def record_circuit_rejected(self, n: int = 1) -> None:
+        """Submit-time fail-fast: the bucket's breaker was open."""
+        with self._lock:
+            self.circuit_rejected += n
+
     # -- reporting --------------------------------------------------------
 
     def snapshot(self, executables: Optional[int] = None) -> Dict:
@@ -196,6 +266,13 @@ class ServingMetrics:
                 "abandoned_inflight": self.abandoned_inflight,
                 "dispatches": self.dispatches,
                 "executables": executables,
+                "resilience": {
+                    "wedged": self.wedged,
+                    "quarantined_threads": self.quarantined_threads,
+                    "circuit_rejected": self.circuit_rejected,
+                    "breaker_transitions":
+                        dict(self.breaker_transitions),
+                },
                 "queue_depth": {"last": self.depth_last,
                                 "max": self.depth_max,
                                 "mean": round(depth_mean, 3)},

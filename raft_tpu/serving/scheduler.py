@@ -21,6 +21,9 @@ Robustness contract (first-class, not best-effort):
   dispatched request always runs to completion (the executable is
   non-preemptible anyway) — zero deadline-abandoned in-flight work, by
   construction (``Future.set_running_or_notify_cancel`` pins it).
+  Expiry is scanned at submit and, under a dispatch watchdog, from the
+  supervision loop — an in-flight dispatch (even a slow compile) no
+  longer starves already-expired queued requests.
 - **Drain on shutdown**: ``close(drain=True)`` stops intake, finishes
   everything queued, and joins the worker — no leaked threads (the
   PR-3 loader-semaphore lesson, one layer up).
@@ -28,30 +31,63 @@ Robustness contract (first-class, not best-effort):
   dispatch — the engine snapshots its weight tree once per dispatch
   under its lock, so a swap lands between dispatches, never inside one.
 
+Resilience layer (serving/resilience.py; every knob defaults OFF, so
+the base semantics above are unchanged until armed):
+
+- **Dispatch watchdog** (``dispatch_timeout_s``): dispatch execution
+  moves off the queue-owning dispatcher thread onto a supervised
+  executor. A dispatch (capacity probe + compile + gather + device
+  call) exceeding the wall-clock deadline gets a *wedge verdict*: its
+  futures fail with :class:`DispatchWedged`, the stuck thread is
+  quarantined and accounted (Python can't kill it — a replacement is
+  spawned and the leak lands in metrics), the suspect bucket's
+  executable is dropped from the engine, and queued-deadline scanning
+  never stopped while the dispatch was in flight.
+- **Per-bucket circuit breakers** (``breaker_failures`` > 0): K
+  consecutive failures/wedges open a request-shape's breaker — its
+  traffic fails fast with :class:`CircuitOpen` (submit-time and
+  queued) instead of burning the queue, while other shapes keep
+  serving. After a jittered backoff the breaker half-opens; the next
+  request is the probe, and a probe against a dropped bucket lazily
+  recompiles it (``ensure_bucket``). Success closes the breaker.
+- **Health surface**: :meth:`health` reports
+  ``healthy | degraded | wedged`` plus per-bucket breaker states,
+  worker liveness, last-dispatch age, and quarantined threads; state
+  and breaker transitions append as events to the same metrics.jsonl
+  the snapshots use (the supervisor-alerting pattern — dashboards tail
+  one file).
+
 Fault drills: every micro-batch passes through the ``serve.request``
 fault site (testing/faults) — ``raise`` fails just that batch's
-futures (the worker survives), ``hang`` models a half-up device
-stalling dispatch until the queue sheds.
+futures (the worker survives), ``hang`` models a half-up device. The
+supervised executor adds ``serve.dispatch_exec`` and the engine
+``engine.compile`` — the chaos sites ``serve_bench --chaos`` drives.
 
 Observability rides along in :class:`~raft_tpu.serving.metrics.
 ServingMetrics`: per-bucket latency histograms for each stage
 (enqueue->dispatch->complete), batch occupancy, queue depth, shed and
-deadline-miss counters, snapshotted to ``metrics.jsonl`` on close and
-dumpable on demand (``write_metrics``).
+deadline-miss counters, wedge/quarantine/breaker counters, snapshotted
+to ``metrics.jsonl`` on close and dumpable on demand
+(``write_metrics``).
 """
 
 from __future__ import annotations
 
 import collections
+import random
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Deque, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from raft_tpu.ops.padding import pad_amounts
 from raft_tpu.serving.metrics import ServingMetrics
+from raft_tpu.serving.resilience import (BREAKER_CLOSED, BREAKER_OPEN,
+                                         CircuitBreaker, CircuitOpen,
+                                         DispatchExecutor, DispatchWedged,
+                                         _DispatchJob)
 from raft_tpu.testing.faults import fault_point
 
 
@@ -101,21 +137,53 @@ class MicroBatchScheduler:
     count. ``gather_window_s``: how long dispatch holds an underfull
     micro-batch open for concurrent submitters — the latency/occupancy
     knob (bounded; an already-full batch never waits).
+
+    Resilience knobs (all default OFF — identical semantics until set):
+    ``dispatch_timeout_s`` arms the dispatch watchdog (must exceed
+    ``gather_window_s`` plus a worst-case compile — the deadline covers
+    the whole supervised dispatch). ``breaker_failures`` > 0 arms
+    per-bucket circuit breakers opening after that many consecutive
+    failures/wedges, with jittered exponential backoff
+    (``breaker_backoff_s`` base, ``breaker_backoff_max_s`` cap,
+    ``breaker_rng`` injectable for deterministic drills) before the
+    half-open probe.
     """
 
     def __init__(self, engine, *, max_queue: int = 64, max_batch: int = 8,
                  gather_window_s: float = 0.002,
+                 dispatch_timeout_s: Optional[float] = None,
+                 breaker_failures: int = 0,
+                 breaker_backoff_s: float = 0.25,
+                 breaker_backoff_max_s: float = 30.0,
+                 breaker_rng: Optional[random.Random] = None,
                  metrics: Optional[ServingMetrics] = None,
                  metrics_path: Optional[str] = None):
         self.engine = engine
         self.max_queue = int(max_queue)
         self.max_batch = int(max_batch)
         self.gather_window_s = float(gather_window_s)
+        self.dispatch_timeout_s = (float(dispatch_timeout_s)
+                                   if dispatch_timeout_s else None)
         self.metrics = metrics or ServingMetrics(metrics_path)
         self._cv = threading.Condition()
         self._q: Deque[_Request] = collections.deque()
         self._capacity: Dict[Tuple[int, int], int] = {}
         self._closed = False
+        self._breaker_failures = int(breaker_failures)
+        self._breaker_backoff_s = float(breaker_backoff_s)
+        self._breaker_backoff_max_s = float(breaker_backoff_max_s)
+        self._breaker_rng = breaker_rng
+        self._breakers: Dict[Tuple[int, int], CircuitBreaker] = {}
+        self._exec = (DispatchExecutor()
+                      if self.dispatch_timeout_s is not None else None)
+        # guards the _health_state compare-and-set + event emit:
+        # refreshes race in from the dispatcher, submitters (breaker
+        # transitions), and health() callers, and an unsynchronized
+        # RMW would emit duplicate/stale-previous serving_state events
+        self._state_lock = threading.Lock()
+        self._health_state = "healthy"
+        self._inflight_since: Optional[float] = None
+        self._last_dispatch_done: Optional[float] = None
         self._worker = threading.Thread(
             target=self._run, name="MicroBatchScheduler-dispatch",
             daemon=True)
@@ -128,7 +196,8 @@ class MicroBatchScheduler:
                want_low: bool = False) -> Future:
         """Enqueue ONE ``(H, W, 3)`` frame pair; returns a Future
         resolving to :class:`ServeResult`. Raises
-        :class:`BackpressureError` when the queue is full and
+        :class:`BackpressureError` when the queue is full,
+        :class:`CircuitOpen` when the shape's breaker is open, and
         :class:`SchedulerClosed` after ``close()``."""
         image1 = np.asarray(image1, np.float32)
         image2 = np.asarray(image2, np.float32)
@@ -162,13 +231,35 @@ class MicroBatchScheduler:
                 # row, but fail it here with a cause instead of
                 # returning NaN flow from the device
                 raise ValueError("flow_init contains non-finite values")
+        key = tuple(image1.shape[:2])
+        with self._cv:
+            if self._closed:
+                # checked before the breaker: a closed scheduler must
+                # say so — CircuitOpen's "retry after backoff" would
+                # send the caller into a futile retry loop
+                raise SchedulerClosed("scheduler is closed")
+        br = self._breakers.get(key)
+        if br is not None and br.state() == BREAKER_OPEN:
+            # fail fast at intake: an open bucket must not burn queue
+            # slots healthy shapes could use (state() promotes an
+            # expired open to half_open, so the first submit past the
+            # backoff gets through as the probe)
+            self.metrics.record_circuit_rejected()
+            raise CircuitOpen(
+                f"bucket {key} circuit open ({br.consecutive} "
+                "consecutive failures) — failing fast; retry after "
+                "backoff")
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
-        req = _Request(image1, image2, tuple(image1.shape[:2]),
-                       flow_init, want_low, deadline)
+        req = _Request(image1, image2, key, flow_init, want_low, deadline)
         with self._cv:
             if self._closed:
                 raise SchedulerClosed("scheduler is closed")
+            # sweep expired entries first: they must not hold
+            # backpressure slots, and submit is an expiry edge — a
+            # deadline fires within one submit/supervision tick even
+            # while a dispatch is in flight
+            self._sweep_locked(time.monotonic())
             if len(self._q) >= self.max_queue:
                 self.metrics.record_shed()
                 raise BackpressureError(
@@ -184,6 +275,87 @@ class MicroBatchScheduler:
         (the engine snapshots its tree once per dispatch)."""
         self.engine.update_weights(variables)
 
+    # -- breakers / health -------------------------------------------------
+
+    def _breaker(self, key: Tuple[int, int]) -> Optional[CircuitBreaker]:
+        """The shape's breaker, created on first dispatch (so health
+        lists every active bucket). None when breakers are disarmed."""
+        if not self._breaker_failures:
+            return None
+        with self._cv:
+            br = self._breakers.get(key)
+            if br is not None:
+                return br
+        label = f"{key[0]}x{key[1]}"
+        br = CircuitBreaker(
+            failures=self._breaker_failures,
+            base_s=self._breaker_backoff_s,
+            max_s=self._breaker_backoff_max_s,
+            rng=self._breaker_rng,
+            on_transition=lambda old, new, label=label:
+                self._on_breaker(label, old, new))
+        with self._cv:
+            return self._breakers.setdefault(key, br)
+
+    def _on_breaker(self, label: str, old: str, new: str) -> None:
+        self.metrics.record_breaker_transition(label, old, new)
+        self._refresh_state(f"breaker {label} {old}->{new}")
+
+    def _compute_state(self) -> str:
+        if not self._closed and not self._worker.is_alive():
+            return "wedged"      # dispatcher died: nothing drains
+        t0 = self._inflight_since
+        if (self.dispatch_timeout_s is not None and t0 is not None
+                and time.monotonic() - t0 > self.dispatch_timeout_s):
+            return "wedged"      # verdict due/being handled right now
+        with self._cv:
+            breakers = list(self._breakers.values())
+        if any(br.peek() != BREAKER_CLOSED for br in breakers):
+            return "degraded"
+        return "healthy"
+
+    def _refresh_state(self, reason: str) -> None:
+        # lock order: _state_lock -> _cv -> breaker lock (compute
+        # walks the breaker board); nothing takes _state_lock while
+        # holding either of the others
+        with self._state_lock:
+            new = self._compute_state()
+            old = self._health_state
+            if new != old:
+                self._health_state = new
+                self.metrics.record_state_change(old, new, reason)
+
+    def health(self) -> Dict:
+        """Operator surface: overall state (``healthy`` — everything
+        closed and live; ``degraded`` — at least one bucket breaker
+        open/half-open; ``wedged`` — a dispatch is past its deadline or
+        the dispatcher thread is dead), per-bucket breaker states,
+        worker liveness, ages, and the quarantined-thread leak
+        count."""
+        self._refresh_state("health probe")
+        now = time.monotonic()
+        with self._cv:
+            breakers = dict(self._breakers)
+            depth = len(self._q)
+        t0 = self._inflight_since
+        done = self._last_dispatch_done
+        return {
+            "state": self._health_state,
+            "buckets": {f"{h}x{w}": br.snapshot()
+                        for (h, w), br in sorted(breakers.items())},
+            "worker_alive": self._worker.is_alive(),
+            "dispatch_worker_alive": (self._exec.worker_alive()
+                                      if self._exec else None),
+            "queue_depth": depth,
+            "inflight_age_s": (round(now - t0, 3)
+                               if t0 is not None else None),
+            "last_dispatch_age_s": (round(now - done, 3)
+                                    if done is not None else None),
+            "quarantined_threads": self.metrics.quarantined_threads,
+            "quarantined_alive": (self._exec.quarantined_alive()
+                                  if self._exec else 0),
+        }
+
     # -- dispatch loop -----------------------------------------------------
 
     def _shape_capacity(self, key: Tuple[int, int]) -> int:
@@ -195,7 +367,9 @@ class MicroBatchScheduler:
                 # no compiled bucket fits this spatial shape: pre-warm
                 # exactly one at max_batch so every later fill count
                 # batch-fills into it (executable count stays one per
-                # shape, the H3 discipline)
+                # shape, the H3 discipline). After a wedge dropped the
+                # bucket, this is also the half-open probe's lazy
+                # recompile.
                 fit = self.engine.ensure_bucket(self.max_batch, h, w)[0]
             cap = max(1, min(fit, self.max_batch))
             self._capacity[key] = cap
@@ -203,12 +377,45 @@ class MicroBatchScheduler:
 
     def _expire(self, req: _Request, now: float) -> bool:
         if req.deadline is not None and now > req.deadline:
+            try:
+                req.future.set_exception(DeadlineExceeded(
+                    f"deadline expired after {now - req.t_submit:.3f}s "
+                    "in queue (never dispatched)"))
+            except InvalidStateError:
+                # the caller cancelled between the cancelled() check
+                # and here — count it as the cancel it was, and don't
+                # let the race kill a submitter or the dispatcher
+                self.metrics.record_cancelled()
+                return True
             self.metrics.record_deadline_miss()
-            req.future.set_exception(DeadlineExceeded(
-                f"deadline expired after {now - req.t_submit:.3f}s in "
-                "queue (never dispatched)"))
             return True
         return False
+
+    def _sweep_locked(self, now: float) -> None:
+        """Drop expired/caller-cancelled entries from the queue
+        (caller holds ``_cv``). The single queue representation — every
+        path that rewrites the queue goes through here or ``_take``,
+        both keeping it a deque (a plain-list rebind would crash
+        ``close``'s ``popleft``; pinned by regression test)."""
+        if not any(r.deadline is not None or r.future.cancelled()
+                   for r in self._q):
+            return
+        keep: Deque[_Request] = collections.deque()
+        for r in self._q:
+            if r.future.cancelled():
+                self.metrics.record_cancelled()
+            elif self._expire(r, now):
+                pass
+            else:
+                keep.append(r)
+        self._q = keep
+
+    def _expiry_scan(self) -> None:
+        """Expiry edge usable from the supervision loop while a
+        dispatch is in flight — queued deadlines fire within one poll
+        tick instead of waiting out a slow compile or hung device."""
+        with self._cv:
+            self._sweep_locked(time.monotonic())
 
     def _gather(self, key: Tuple[int, int], capacity: int) -> None:
         """Hold dispatch open briefly so concurrent submitters can fill
@@ -246,6 +453,24 @@ class MicroBatchScheduler:
             self._q = keep
         return taken
 
+    def _fail_requests(self, requests: List[_Request], exc: Exception
+                      ) -> int:
+        """Settle ``requests`` with ``exc``; returns how many actually
+        settled (already-done futures — raced by a wedge verdict or a
+        late-waking quarantined thread — are skipped, keeping
+        submitted == completed + failed + deadline_missed + cancelled
+        exact)."""
+        n = 0
+        for r in requests:
+            if r.future.done():
+                continue
+            try:
+                r.future.set_exception(exc)
+                n += 1
+            except InvalidStateError:
+                pass
+        return n
+
     def _run(self) -> None:
         while True:
             with self._cv:
@@ -256,52 +481,183 @@ class MicroBatchScheduler:
                         return
                     continue
                 key = self._q[0].key
-            try:
-                # capacity may compile a bucket — never under the queue
-                # lock (submitters would shed through the whole
-                # compile)
-                capacity = self._shape_capacity(key)
-            except Exception as exc:
-                # an unservable shape (mesh-invalid extent, a compile
-                # failure) fails ITS requests — it must not kill the
-                # dispatcher and strand every queued future unsettled
-                # behind a dead thread
-                doomed = self._take(key, self.max_batch)
-                self.metrics.record_failure(len(doomed))
-                for r in doomed:
-                    if not r.future.done():
-                        r.future.set_exception(exc)
+            br = self._breaker(key)
+            if br is not None and br.state() == BREAKER_OPEN:
+                # queued work behind an open breaker fails fast —
+                # neither starving until deadline nor burning dispatch
+                # slots other shapes could use
+                doomed = self._take(key, self.max_queue or 1)
+                n = self._fail_requests(doomed, CircuitOpen(
+                    f"bucket {key} circuit open — failing fast"))
+                self.metrics.record_failure(n)
                 continue
-            self._gather(key, capacity)
-            batch = self._take(key, capacity)
-            if batch:
-                self._dispatch(key, batch)
+            if self._exec is None:
+                job = _DispatchJob(None)
+                self._serve_key(key, job)
+                self._after_dispatch(key, job)
+            else:
+                self._supervise(key)
 
-    def _dispatch(self, key: Tuple[int, int], batch: List[_Request]
-                  ) -> None:
+    def _supervise(self, key: Tuple[int, int]) -> None:
+        """Run one supervised dispatch for ``key`` on the executor,
+        scanning queued deadlines while it is in flight; wedge verdict
+        past ``dispatch_timeout_s``."""
+        timeout = self.dispatch_timeout_s
+        job = self._exec.submit(
+            lambda j, key=key: self._serve_key(key, j))
+        self._inflight_since = time.monotonic()
+        try:
+            poll = min(0.02, timeout / 4)
+            while not job.done.wait(poll):
+                self._expiry_scan()
+                if time.monotonic() - self._inflight_since > timeout:
+                    self._wedge_verdict(key, job)
+                    return
+            self._after_dispatch(key, job)
+        finally:
+            self._inflight_since = None
+            self._refresh_state("dispatch settled")
+
+    def _wedge_error(self, key: Tuple[int, int]) -> DispatchWedged:
+        return DispatchWedged(
+            f"dispatch for bucket {key[0]}x{key[1]} exceeded "
+            f"dispatch_timeout_s={self.dispatch_timeout_s}: futures "
+            "failed, thread quarantined, executable dropped — "
+            "half-open probe will recompile")
+
+    def _wedge_verdict(self, key: Tuple[int, int], job: _DispatchJob
+                       ) -> None:
+        """The watchdog's exit-class discipline, serving-side: fail the
+        wedged batch, quarantine + replace the stuck thread (accounted,
+        not hidden), drop the suspect executable, open the breaker."""
+        timeout = self.dispatch_timeout_s
+        job.abandoned = True  # a late-waking thread must abort, not
+        #                       dispatch into (and recompile) a
+        #                       dropped bucket
+        self._inflight_since = None  # supervision is over: health is
+        #                              degraded now, not wedged
+        label = f"{key[0]}x{key[1]}"
+        # verdict consequences land BEFORE the futures fail, so a
+        # caller woken by its DispatchWedged observes consistent state
+        # (executable dropped, breaker open, health degraded)
+        if job.bucket is not None:
+            # engine recovery: the executable that hung is suspect —
+            # drop it (and the cached capacity routed through it) so
+            # the half-open probe recompiles from clean state
+            self.engine.drop_bucket(job.bucket)
+        self._capacity.pop(key, None)
+        br = self._breaker(key)
+        if br is not None:
+            br.record_failure(wedged=True)
+        alive = self._exec.quarantine_and_replace()
+        self.metrics.record_quarantined(label, alive=alive)
+        exc = self._wedge_error(key)
+        # fail the taken batch; a wedge before _take (a hung compile in
+        # the capacity probe) instead fails the shape's queued requests
+        # — nothing may stay stranded behind a stuck thread
+        batch = job.batch
+        if batch is None:
+            batch = self._take(key, self.max_queue or 1)
+        n = self._fail_requests(batch, exc)
+        self.metrics.record_wedge(label, failed=n, timeout_s=timeout)
+        self._refresh_state(f"wedge verdict on {label}")
+
+    def _after_dispatch(self, key: Tuple[int, int], job: _DispatchJob
+                        ) -> None:
+        """Outcome bookkeeping for a dispatch that settled in time."""
+        if job.error is not None and job.batch:
+            # a failure that escaped _serve_key's routing (e.g. the
+            # serve.dispatch_exec fault firing mid-job) with requests
+            # already taken: settle them here — never strand
+            n = self._fail_requests(list(job.batch), job.error)
+            self.metrics.record_failure(n)
+        br = self._breaker(key)
+        if job.error is not None or job.outcome == "failed":
+            if br is not None:
+                br.record_failure()
+        elif job.outcome == "ok":
+            self._last_dispatch_done = time.monotonic()
+            if br is not None:
+                br.record_success()
+        self._refresh_state("dispatch outcome")
+
+    def _serve_key(self, key: Tuple[int, int], job: _DispatchJob) -> None:
+        """One micro-batch for ``key``: capacity (may compile) ->
+        gather -> take -> dispatch. Runs inline on the dispatcher
+        thread (no watchdog) or on the supervised executor."""
+        try:
+            # capacity may compile a bucket — never under the queue
+            # lock (submitters would shed through the whole compile)
+            capacity = self._shape_capacity(key)
+        except Exception as exc:
+            # an unservable shape (mesh-invalid extent, a compile
+            # failure) fails ITS requests — it must not kill the
+            # dispatcher and strand every queued future unsettled
+            # behind a dead thread
+            doomed = self._take(key, self.max_batch)
+            job.batch = doomed
+            self.metrics.record_failure(self._fail_requests(doomed, exc))
+            job.outcome = "failed"
+            return
+        if job.abandoned:
+            # the capacity probe (a compile) outlived the watchdog: a
+            # quarantined thread must not take fresh work — but its
+            # compile was NOT wasted (ensure_bucket's first-insert-wins
+            # means the replacement's probe finds the bucket ready)
+            return
+        self._gather(key, capacity)
+        batch = self._take(key, capacity)
+        job.batch = batch
+        if job.abandoned:
+            # verdict landed between the check above and the take: the
+            # verdict saw batch=None, so settling these is OUR job —
+            # a quarantined thread may never strand what it took
+            self.metrics.record_failure(self._fail_requests(
+                batch, self._wedge_error(key)))
+            return
+        if batch:
+            self._dispatch(key, batch, job)
+
+    def _dispatch(self, key: Tuple[int, int], batch: List[_Request],
+                  job: _DispatchJob) -> None:
         live: List[_Request] = []
         for r in batch:
             # once this returns True the future can no longer be
             # cancelled: a dispatched request is never abandoned — the
             # acceptance invariant behind metrics.abandoned_inflight==0
-            if r.future.set_running_or_notify_cancel():
+            try:
+                running = r.future.set_running_or_notify_cancel()
+            except InvalidStateError:
+                continue  # wedge verdict settled it between take and here
+            if running:
                 live.append(r)
             else:
                 self.metrics.record_cancelled()
         if not live:
             return
+        job.batch = live
         h, w = key
         n = len(live)
         t_disp = time.monotonic()
         try:  # EVERYTHING here routes failures to the batch's futures —
             # nothing may escape and kill the dispatcher thread
             bucket = self.engine.route_bucket(n, h, w)
+            job.bucket = bucket
             label = "x".join(map(str, bucket))
             with self._cv:
                 depth = len(self._q)
             self.metrics.record_dispatch(label, filled=n,
                                          capacity=bucket[0], depth=depth)
             fault_point("serve.request")
+            if job.abandoned:
+                # wedge verdict landed while we were stuck above:
+                # routing into the engine now would compile a leaked
+                # duplicate. Settle anything the verdict's batch read
+                # raced past (it may have seen batch=None) — a
+                # quarantined thread never strands what it took
+                self.metrics.record_failure(self._fail_requests(
+                    live, self._wedge_error(key)))
+                return
             i1 = np.stack([r.image1 for r in live])
             i2 = np.stack([r.image2 for r in live])
             if getattr(self.engine, "warm_start", False):
@@ -325,15 +681,17 @@ class MicroBatchScheduler:
             for i, r in enumerate(live):
                 low = lows[i] if (lows is not None and r.want_low) \
                     else None
-                r.future.set_result(ServeResult(flows[i], low))
+                try:
+                    r.future.set_result(ServeResult(flows[i], low))
+                except InvalidStateError:
+                    continue  # wedge verdict settled it first
                 self.metrics.record_complete(
                     label, queue_ms=(t_disp - r.t_submit) * 1e3,
                     device_ms=(t_done - t_disp) * 1e3)
+            job.outcome = "ok"
         except Exception as exc:  # route to the callers; worker survives
-            failed = [r for r in live if not r.future.done()]
-            self.metrics.record_failure(len(failed))
-            for r in failed:
-                r.future.set_exception(exc)
+            self.metrics.record_failure(self._fail_requests(live, exc))
+            job.outcome = "failed"
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -348,24 +706,35 @@ class MicroBatchScheduler:
     def close(self, drain: bool = True, timeout: float = 120.0) -> None:
         """Stop intake; ``drain=True`` finishes everything queued
         first, ``drain=False`` fails pending work with
-        :class:`SchedulerClosed`. Joins the worker (leaked dispatch
-        threads are a bug, not a shutdown mode) and writes a final
-        metrics snapshot when a metrics path is configured.
-        Idempotent."""
+        :class:`SchedulerClosed`. Joins the worker and the supervised
+        executor (leaked dispatch threads are a bug, not a shutdown
+        mode; quarantined wedge threads are the accounted exception —
+        daemon, reported in ``health()``) and writes a final metrics
+        snapshot when a metrics path is configured. Idempotent."""
         with self._cv:
             first = not self._closed
             self._closed = True
             if not drain:
+                n = 0
+                exc = SchedulerClosed("dropped by no-drain close")
                 while self._q:
                     r = self._q.popleft()
                     if not r.future.done():
-                        r.future.set_exception(SchedulerClosed(
-                            "dropped by no-drain close"))
+                        try:
+                            r.future.set_exception(exc)
+                            n += 1
+                        except InvalidStateError:
+                            pass
+                self.metrics.record_failure(n)
             self._cv.notify_all()
         self._worker.join(timeout)
         if self._worker.is_alive():
             raise RuntimeError(
                 f"scheduler worker failed to drain within {timeout}s")
+        if self._exec is not None and not self._exec.close(timeout):
+            raise RuntimeError(
+                "supervised dispatch executor failed to stop within "
+                f"{timeout}s")
         if first and self.metrics.path:
             self.metrics.write_snapshot(
                 executables=self.executable_count())

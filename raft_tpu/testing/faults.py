@@ -23,10 +23,27 @@ Plan entries (a list of dicts, or ``{"faults": [...]}``):
     scheduler's worker, serving/scheduler.py — ``kind="raise"`` fails
     just that batch's futures and the worker survives, ``kind="hang"``
     models a half-up device stalling dispatch until the bounded queue
-    sheds and queued deadlines expire).
+    sheds and queued deadlines expire), ``serve.dispatch_exec`` (top of
+    the supervised dispatch executor's job loop,
+    serving/resilience.py — a hang here wedges the executor worker
+    itself and drills the watchdog's quarantine-and-replace path),
+    ``engine.compile`` (immediately before a real XLA bucket compile in
+    ``RAFTEngine._get_executable`` — cache hits never fire it;
+    ``raise`` models an uncompilable shape, ``hang`` a compile that
+    never returns).
 ``at``
-    1-based occurrence at which the fault fires (default 1). Each entry
-    fires exactly once.
+    1-based occurrence at which the entry becomes eligible (default 1).
+    With the defaults below, each entry fires exactly once — the
+    original one-shot semantics.
+``count``
+    Maximum number of fires (default 1; ``0`` = unlimited). With
+    ``at``, this scopes an entry to "occurrences N through N+count-1"
+    — the nth-call scoping chaos plans randomize.
+``p``
+    Per-eligible-call fire probability in ``(0, 1]`` (default 1.0).
+    Draws come from a plan-scoped ``random.Random`` seeded by the
+    plan's top-level ``"seed"`` key (default 0), so a chaos plan is
+    bit-reproducible: same plan, same call sequence, same fires.
 ``kind``
     ``"raise"`` (FaultInjected), ``"hang"`` (sleep ``hang_s``, default
     effectively forever — what a half-up backend looks like),
@@ -48,6 +65,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 from typing import List, Optional
@@ -66,13 +84,16 @@ class FaultInjected(RuntimeError):
 
 
 class _Entry:
-    __slots__ = ("site", "at", "kind", "hang_s", "seen", "fired")
+    __slots__ = ("site", "at", "kind", "hang_s", "p", "count", "seen",
+                 "fires")
 
     def __init__(self, spec: dict):
         self.site = spec["site"]
         self.at = int(spec.get("at", 1))
         self.kind = spec["kind"]
         self.hang_s = float(spec.get("hang_s", 3600.0))
+        self.p = float(spec.get("p", 1.0))
+        self.count = int(spec.get("count", 1))
         if self.kind not in _ALL_KINDS:
             raise ValueError(
                 f"fault kind {self.kind!r} at site {self.site!r}: choose "
@@ -80,23 +101,39 @@ class _Entry:
         if self.at < 1:
             raise ValueError(f"fault at={self.at} at site {self.site!r}: "
                              "occurrence counts are 1-based")
+        if not 0.0 < self.p <= 1.0:
+            raise ValueError(f"fault p={self.p} at site {self.site!r}: "
+                             "must be in (0, 1]")
+        if self.count < 0:
+            raise ValueError(f"fault count={self.count} at site "
+                             f"{self.site!r}: must be >= 0 (0=unlimited)")
         self.seen = 0
-        self.fired = False
+        self.fires = 0
+
+    @property
+    def exhausted(self) -> bool:
+        return self.count > 0 and self.fires >= self.count
 
 
 _PLAN: Optional[List[_Entry]] = None
+_RNG = random.Random(0)
 _LOCK = threading.Lock()
 
 
 def arm(plan) -> None:
-    """Arm ``plan`` (list of entry dicts, or ``{"faults": [...]}``);
-    entries scoped to a different supervisor attempt are dropped."""
-    global _PLAN
+    """Arm ``plan`` (list of entry dicts, or ``{"faults": [...],
+    "seed": N}``); entries scoped to a different supervisor attempt are
+    dropped. ``seed`` (default 0) drives the probabilistic-``p`` draws
+    deterministically."""
+    global _PLAN, _RNG
+    seed = 0
     if isinstance(plan, dict):
+        seed = int(plan.get("seed", 0))
         plan = plan.get("faults", [])
     attempt = int(os.environ.get("RAFT_SUPERVISOR_ATTEMPT", "0"))
     entries = [_Entry(spec) for spec in plan
                if int(spec.get("attempt", attempt)) == attempt]
+    _RNG = random.Random(seed)
     _PLAN = entries or None
 
 
@@ -118,13 +155,13 @@ def arm_from_env() -> None:
 
 
 def armed(site: str) -> bool:
-    """True iff an un-fired entry for ``site`` exists. Lets a call site
-    gate expensive setup (e.g. waiting out an async save so there are
-    bytes on disk to corrupt) on the drill actually being live."""
+    """True iff an un-exhausted entry for ``site`` exists. Lets a call
+    site gate expensive setup (e.g. waiting out an async save so there
+    are bytes on disk to corrupt) on the drill actually being live."""
     if _PLAN is None:
         return False
     with _LOCK:
-        return any(e.site == site and not e.fired for e in _PLAN)
+        return any(e.site == site and not e.exhausted for e in _PLAN)
 
 
 def _match(site: str, kinds) -> Optional[_Entry]:
@@ -132,15 +169,18 @@ def _match(site: str, kinds) -> Optional[_Entry]:
     (if any) whose occurrence just came due. Each call type counts only
     the kinds it can serve, so a site with both a ``fault_point`` and a
     ``fault_file`` call per event still counts one occurrence per event
-    for every entry."""
+    for every entry. Eligible entries (``seen >= at``, not exhausted)
+    fire with probability ``p`` from the plan-seeded rng."""
     due = None
     with _LOCK:
         for e in _PLAN or ():
-            if e.site != site or e.fired or e.kind not in kinds:
+            if e.site != site or e.exhausted or e.kind not in kinds:
                 continue
             e.seen += 1
             if due is None and e.seen >= e.at:
-                e.fired = True
+                if e.p < 1.0 and _RNG.random() >= e.p:
+                    continue
+                e.fires += 1
                 due = e
     return due
 
@@ -154,7 +194,7 @@ def fault_point(site: str) -> None:
         return
     if e.kind == "raise":
         raise FaultInjected(
-            f"injected fault at {site} (occurrence {e.at})")
+            f"injected fault at {site} (occurrence {e.seen})")
     if e.kind == "hang":
         time.sleep(e.hang_s)
         return

@@ -1,0 +1,64 @@
+"""Subprocess body for the serving crash-class chaos drill.
+
+``kind="crash"`` is ``os._exit`` — nothing can be asserted in-process
+afterwards, so the test (tests/test_scheduler.py) runs THIS worker as a
+child with a crash plan armed at a serving site and asserts the
+process dies with ``CRASH_EXIT_CODE`` (the PR-3 exit-code discipline:
+the supervisor layer, not the scheduler, owns crash recovery). Uses a
+stub engine so the child never compiles anything."""
+
+import sys
+import time
+
+import numpy as np
+
+from raft_tpu.serving.scheduler import MicroBatchScheduler
+from raft_tpu.testing import faults
+
+
+def _pad8(x):
+    return -(-x // 8) * 8
+
+
+class _StubEngine:
+    warm_start = False
+
+    def __init__(self):
+        self._compiled = {}
+
+    def bucket_capacity(self, h, w):
+        fits = [s[0] for s in self._compiled
+                if s[1] == _pad8(h) and s[2] == _pad8(w)]
+        return max(fits) if fits else None
+
+    def ensure_bucket(self, b, h, w):
+        shape = (b, _pad8(h), _pad8(w))
+        self._compiled[shape] = object()
+        return shape
+
+    def route_bucket(self, b, h, w):
+        return (b, _pad8(h), _pad8(w))
+
+    def drop_bucket(self, shape):
+        return self._compiled.pop(shape, None) is not None
+
+    def infer_batch(self, i1, i2, **kw):
+        return np.zeros(i1.shape[:3] + (2,), np.float32)
+
+
+def main():
+    site = sys.argv[1] if len(sys.argv) > 1 else "serve.dispatch_exec"
+    faults.arm([{"site": site, "kind": "crash"}])
+    sched = MicroBatchScheduler(_StubEngine(), gather_window_s=0.0,
+                                dispatch_timeout_s=5.0)
+    img = np.zeros((16, 16, 3), np.float32)
+    sched.submit(img, img)
+    # the armed crash fires os._exit(CRASH_EXIT_CODE) on the dispatch
+    # path; if it somehow doesn't, exit 0 and let the test fail on the
+    # return code
+    time.sleep(10)
+    sched.close()
+
+
+if __name__ == "__main__":
+    main()

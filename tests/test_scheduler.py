@@ -1,9 +1,16 @@
 """Serving front-end acceptance: async micro-batch coalescing,
 deadlines, backpressure, drain-on-shutdown, warm-start sessions, and
 the metrics surface — the ISSUE-6 ragged-traffic drill plus the
-fault-injection matrix for the ``serve.request`` site."""
+fault-injection matrix for the ``serve.request`` site — and the
+ISSUE-7 resilience layer: dispatch watchdog (wedge verdicts,
+quarantine-and-replace), per-bucket circuit breakers, engine
+drop + lazy recompile, the ``health()`` surface, and the chaos soak."""
 
 import json
+import os
+import random
+import subprocess
+import sys
 import threading
 import time
 
@@ -16,6 +23,8 @@ import jax.numpy as jnp
 from raft_tpu.config import RAFTConfig
 from raft_tpu.models import RAFT
 from raft_tpu.serving.engine import RAFTEngine
+from raft_tpu.serving.resilience import (CircuitBreaker, CircuitOpen,
+                                         DispatchWedged)
 from raft_tpu.serving.scheduler import (BackpressureError, DeadlineExceeded,
                                         MicroBatchScheduler, SchedulerClosed,
                                         ServeResult)
@@ -435,6 +444,475 @@ class TestVideoSessions:
             blocker.result(timeout=120)
 
 
+def _pad8(x):
+    return -(-x // 8) * 8
+
+
+class _FakeEngine:
+    """Duck-typed engine for fast, deterministic resilience drills:
+    per-shape hang/fail behavior without XLA. Mirrors the real engine's
+    scheduler-facing surface (capacity/route/ensure/drop/_compiled)."""
+
+    warm_start = False
+
+    def __init__(self, infer_delay_s=0.0):
+        self._compiled = {}
+        self.infer_delay_s = infer_delay_s
+        self.compile_calls = 0
+        self.hang_shapes = {}     # (h, w) -> sleep seconds in infer
+        self.fail_shapes = set()  # (h, w) -> raise in infer
+
+    def bucket_capacity(self, h, w):
+        hp, wp = _pad8(h), _pad8(w)
+        fits = [s[0] for s in self._compiled
+                if s[1] == hp and s[2] == wp]
+        return max(fits) if fits else None
+
+    def ensure_bucket(self, b, h, w):
+        self.compile_calls += 1
+        shape = (b, _pad8(h), _pad8(w))
+        self._compiled[shape] = object()
+        return shape
+
+    def route_bucket(self, b, h, w):
+        cap = self.bucket_capacity(h, w)
+        return (cap or b, _pad8(h), _pad8(w))
+
+    def drop_bucket(self, shape):
+        return self._compiled.pop(shape, None) is not None
+
+    def infer_batch(self, i1, i2, **kw):
+        key = (i1.shape[1], i1.shape[2])
+        if key in self.hang_shapes:
+            time.sleep(self.hang_shapes[key])
+        if key in self.fail_shapes:
+            raise RuntimeError(f"device error at {key}")
+        if self.infer_delay_s:
+            time.sleep(self.infer_delay_s)
+        return np.zeros(i1.shape[:3] + (2,), np.float32)
+
+
+def _wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _retry_until_served(sched, rng, h=32, w=32, timeout=10.0):
+    """Probe a shape until it serves (drives the half-open probe);
+    returns the result or None on budget exhaustion."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            return sched.submit(
+                rng.rand(h, w, 3).astype(np.float32),
+                rng.rand(h, w, 3).astype(np.float32)).result(
+                timeout=timeout)
+        except (CircuitOpen, DispatchWedged):
+            time.sleep(0.05)
+    return None
+
+
+class TestCircuitBreakerUnit:
+    def test_round_trip_with_injected_clock(self):
+        t = [0.0]
+        seen = []
+        br = CircuitBreaker(failures=2, base_s=10.0, max_s=40.0,
+                            jitter=0.0, clock=lambda: t[0],
+                            on_transition=lambda o, n: seen.append(
+                                (o, n)))
+        assert br.state() == "closed"
+        br.record_failure()
+        assert br.state() == "closed"      # K=2: one failure holds
+        br.record_failure(wedged=True)
+        assert br.peek() == "open" and br.opens == 1 and br.wedges == 1
+        snap = br.snapshot()
+        assert snap["state"] == "open" and snap["retry_in_s"] == 10.0
+        t[0] = 9.9
+        assert br.peek() == "open"
+        t[0] = 10.1
+        # peek reports the promotion without firing it; state commits it
+        assert br.peek() == "half_open"
+        assert ("open", "half_open") not in seen
+        assert br.state() == "half_open"
+        # failed probe: re-open with the NEXT (doubled) backoff
+        br.record_failure()
+        assert br.peek() == "open" and br.opens == 2
+        t[0] = 10.1 + 19.9
+        assert br.peek() == "open"
+        t[0] = 10.1 + 20.1
+        assert br.state() == "half_open"
+        br.record_success()
+        assert br.state() == "closed" and br.consecutive == 0
+        assert seen == [("closed", "open"), ("open", "half_open"),
+                        ("half_open", "open"), ("open", "half_open"),
+                        ("half_open", "closed")]
+        # a recovery resets the backoff series: the next trip starts
+        # from base again
+        br.record_failure()
+        br.record_failure()
+        assert br.snapshot()["retry_in_s"] == 10.0
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(failures=3)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state() == "closed"   # never 3 consecutive
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="failures"):
+            CircuitBreaker(failures=0)
+
+
+class TestDispatchWatchdog:
+    """The wedge verdict on the fast stub engine: deterministic
+    timing, no XLA."""
+
+    def _sched(self, eng, **kw):
+        kw.setdefault("gather_window_s", 0.0)
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("dispatch_timeout_s", 0.3)
+        kw.setdefault("breaker_failures", 1)
+        kw.setdefault("breaker_backoff_s", 0.2)
+        kw.setdefault("breaker_backoff_max_s", 0.2)
+        kw.setdefault("breaker_rng", random.Random(0))
+        return MicroBatchScheduler(eng, **kw)
+
+    def test_wedge_fails_futures_within_timeout_and_recovers(self, rng):
+        before = set(threading.enumerate())
+        eng = _FakeEngine()
+        eng.hang_shapes[(32, 32)] = 1.0
+        sched = self._sched(eng)
+        t0 = time.monotonic()
+        fut = sched.submit(*_pair(rng))
+        with pytest.raises(DispatchWedged):
+            fut.result(timeout=5)
+        # the verdict fired at the timeout, not at the end of the hang
+        assert time.monotonic() - t0 < 0.9
+        h = sched.health()
+        assert h["state"] == "degraded"
+        assert h["buckets"]["32x32"]["state"] in ("open", "half_open")
+        assert h["buckets"]["32x32"]["wedges"] == 1
+        assert h["quarantined_threads"] == 1
+        # while 32x32 is open, the healthy shape keeps serving
+        ok = sched.submit(rng.rand(40, 40, 3).astype(np.float32),
+                          rng.rand(40, 40, 3).astype(np.float32))
+        assert ok.result(timeout=5).flow.shape == (40, 40, 2)
+        # the suspect executable was dropped; the half-open probe
+        # recompiles it lazily and closes the breaker
+        assert (2, 32, 32) not in eng._compiled
+        eng.hang_shapes.clear()
+        assert _retry_until_served(sched, rng) is not None
+        assert (2, 32, 32) in eng._compiled
+        assert _wait_for(lambda: sched.health()["state"] == "healthy")
+        snap = sched.metrics.snapshot()
+        assert snap["resilience"]["wedged"] == 1
+        assert snap["resilience"]["quarantined_threads"] == 1
+        assert snap["resilience"]["breaker_transitions"]["open"] >= 1
+        assert snap["resilience"]["breaker_transitions"]["closed"] >= 1
+        assert snap["abandoned_inflight"] == 0
+        # the accounting identity: every accepted request settled once
+        assert snap["submitted"] == (snap["completed"] + snap["failed"]
+                                     + snap["deadline_missed"]
+                                     + snap["cancelled"])
+        sched.close(drain=True)
+        # the replacement worker joined; the quarantined thread exits
+        # once its hang ends (leak accounted, then gone)
+        assert not _no_leaked_workers(before)
+
+    def test_submit_fails_fast_while_open(self, rng):
+        eng = _FakeEngine()
+        eng.fail_shapes.add((32, 32))
+        sched = self._sched(eng, breaker_backoff_s=30.0,
+                            breaker_backoff_max_s=30.0)
+        bad = sched.submit(*_pair(rng))
+        with pytest.raises(RuntimeError, match="device error"):
+            bad.result(timeout=5)
+        assert _wait_for(
+            lambda: sched.health()["buckets"].get("32x32", {}).get(
+                "state") == "open", timeout=5)
+        with pytest.raises(CircuitOpen, match="failing fast"):
+            sched.submit(*_pair(rng))
+        assert sched.metrics.circuit_rejected == 1
+        sched.close(drain=True)
+
+    def test_open_breaker_fails_queued_work_fast(self, rng):
+        """Requests already queued when the breaker opens fail with
+        CircuitOpen instead of starving until their deadline."""
+        eng = _FakeEngine()
+        eng.hang_shapes[(32, 32)] = 0.8
+        sched = self._sched(eng, breaker_backoff_s=30.0,
+                            breaker_backoff_max_s=30.0)
+        wedged = sched.submit(*_pair(rng))   # dispatched, wedges
+        time.sleep(0.05)
+        queued = [sched.submit(*_pair(rng)) for _ in range(3)]
+        with pytest.raises(DispatchWedged):
+            wedged.result(timeout=5)
+        for q in queued:
+            with pytest.raises(CircuitOpen):
+                q.result(timeout=5)
+        sched.close(drain=True)
+        snap = sched.metrics.snapshot()
+        assert snap["failed"] == 4
+        assert snap["submitted"] == (snap["completed"] + snap["failed"]
+                                     + snap["deadline_missed"]
+                                     + snap["cancelled"])
+
+    def test_wedged_compile_fails_shape_requests(self, rng):
+        """A hang in the capacity probe (engine.compile) wedges before
+        any request is taken: the shape's queued requests must still
+        fail with DispatchWedged — never stranded behind the stuck
+        thread."""
+        eng = _FakeEngine()
+        real_ensure = eng.ensure_bucket
+
+        def slow_ensure(b, h, w):
+            if (h, w) == (32, 32):
+                time.sleep(0.8)
+            return real_ensure(b, h, w)
+
+        eng.ensure_bucket = slow_ensure
+        sched = self._sched(eng)
+        fut = sched.submit(*_pair(rng))
+        with pytest.raises(DispatchWedged):
+            fut.result(timeout=5)
+        sched.close(drain=True)
+
+    def test_deadline_fires_while_dispatch_inflight(self, rng):
+        """The satellite bound: a queued deadline fires within the
+        supervision poll tick even while a slow dispatch is in flight —
+        not after it."""
+        eng = _FakeEngine(infer_delay_s=1.0)
+        sched = self._sched(eng, dispatch_timeout_s=10.0,
+                            breaker_failures=0)
+        first = sched.submit(*_pair(rng))    # dispatched: 1.0s on device
+        time.sleep(0.1)
+        late = sched.submit(*_pair(rng), deadline_s=0.15)
+        # pinned lag bound: expiry at +0.15s, surfaced well inside
+        # 0.6s — the in-flight dispatch (1.0s) did not gate it
+        exc = late.exception(timeout=0.6)
+        assert isinstance(exc, DeadlineExceeded)
+        assert not first.done()              # the dispatch is still out
+        assert first.result(timeout=5).flow.shape == (32, 32, 2)
+        sched.close(drain=True)
+        snap = sched.metrics.snapshot()
+        assert snap["deadline_missed"] == 1
+        assert snap["abandoned_inflight"] == 0
+
+    def test_submit_sweeps_expired_queue_inline_mode(self, rng):
+        """Without a watchdog (inline dispatch — the default), submit
+        itself is an expiry edge: an expired queued request fails when
+        the next submit arrives, not when the busy worker resumes."""
+        eng = _FakeEngine(infer_delay_s=0.8)
+        sched = MicroBatchScheduler(eng, gather_window_s=0.0,
+                                    max_batch=2)
+        blocker = sched.submit(*_pair(rng))  # worker busy 0.8s
+        time.sleep(0.1)
+        doomed = sched.submit(*_pair(rng), deadline_s=0.05)
+        time.sleep(0.15)                     # now expired, still queued
+        sched.submit(*_pair(rng))            # the sweeping edge
+        exc = doomed.exception(timeout=0.2)
+        assert isinstance(exc, DeadlineExceeded)
+        assert not blocker.done()
+        sched.close(drain=True)
+
+    def test_no_drain_close_after_traffic(self, rng):
+        """Regression (ISSUE-7 satellite): close(drain=False) after
+        dispatches have rewritten the queue must fail pending work via
+        the one queue representation — not crash on a type change."""
+        eng = _FakeEngine()
+        sched = MicroBatchScheduler(eng, gather_window_s=0.0,
+                                    max_batch=2)
+        warm = sched.submit(*_pair(rng))
+        assert warm.result(timeout=5).flow.shape == (32, 32, 2)
+        eng.infer_delay_s = 0.5              # wedge the worker briefly
+        blocker = sched.submit(*_pair(rng))
+        time.sleep(0.1)
+        queued = [sched.submit(*_pair(rng)) for _ in range(2)]
+        sched.close(drain=False)             # must not raise
+        assert blocker.result(timeout=5).flow.shape == (32, 32, 2)
+        for q in queued:
+            with pytest.raises(SchedulerClosed):
+                q.result(timeout=5)
+        snap = sched.metrics.snapshot()
+        assert snap["submitted"] == (snap["completed"] + snap["failed"]
+                                     + snap["deadline_missed"]
+                                     + snap["cancelled"])
+
+
+@pytest.fixture(scope="module")
+def resilience_engine(small_setup):
+    """Exact-shapes warm-start engine for the real-stack wedge drill:
+    two 32x32 buckets (batch 3 and 6) so the half-open probe after the
+    (3,32,32) drop recovers through the surviving same-shape bucket
+    without a multi-second recompile gating the drill, plus the
+    healthy 40x40 shape."""
+    cfg, variables = small_setup
+    return RAFTEngine(variables, cfg, iters=1,
+                      envelope=[(BUCKET_BATCH, 32, 32), (6, 32, 32),
+                                (BUCKET_BATCH, 40, 40)],
+                      precompile=True, warm_start=True,
+                      exact_shapes=True)
+
+
+class TestWedgeRecoveryAcceptance:
+    def test_serve_request_hang_no_longer_wedges_frontend(
+            self, resilience_engine, rng, tmp_path):
+        """THE ISSUE-7 acceptance criterion, on the real stack: with
+        dispatch_timeout_s set, a serve.request hang fails its batch
+        with DispatchWedged within the timeout, healthy buckets keep
+        serving, the wedged bucket's breaker opens and recovers via the
+        half-open probe, health() reports degraded during and healthy
+        after, and close(drain=True) returns without leaking the
+        replacement worker."""
+        before = set(threading.enumerate())
+        mpath = str(tmp_path / "metrics.jsonl")
+        faults.arm([{"site": "serve.request", "kind": "hang",
+                     "hang_s": 1.2}])
+        sched = MicroBatchScheduler(
+            resilience_engine, max_batch=BUCKET_BATCH,
+            gather_window_s=0.0, dispatch_timeout_s=0.4,
+            breaker_failures=1, breaker_backoff_s=0.3,
+            breaker_backoff_max_s=0.3, breaker_rng=random.Random(0),
+            metrics_path=mpath)
+        t0 = time.monotonic()
+        wedged = sched.submit(*_pair(rng))
+        with pytest.raises(DispatchWedged, match="dispatch_timeout_s"):
+            wedged.result(timeout=10)
+        assert time.monotonic() - t0 < 1.1   # verdict, not hang-end
+        h = sched.health()
+        assert h["state"] == "degraded"
+        assert h["buckets"]["32x32"]["state"] in ("open", "half_open")
+        assert h["quarantined_threads"] == 1
+        # the suspect executable was dropped
+        assert (BUCKET_BATCH, 32, 32) not in resilience_engine._compiled
+        # healthy bucket serves while 32x32 is open
+        ok = sched.submit(rng.rand(40, 40, 3).astype(np.float32),
+                          rng.rand(40, 40, 3).astype(np.float32))
+        assert ok.result(timeout=120).flow.shape == (40, 40, 2)
+        # recovery: the half-open probe serves through the surviving
+        # same-shape bucket and closes the breaker
+        res = _retry_until_served(sched, rng, timeout=30)
+        assert res is not None and res.flow.shape == (32, 32, 2)
+        assert _wait_for(lambda: sched.health()["state"] == "healthy")
+        snap = sched.metrics.snapshot()
+        assert snap["resilience"]["wedged"] == 1
+        assert snap["resilience"]["quarantined_threads"] == 1
+        assert snap["abandoned_inflight"] == 0
+        sched.close(drain=True)
+        # transitions landed as events in the shared metrics.jsonl
+        recs = [json.loads(line) for line in open(mpath)]
+        events = [r["event"] for r in recs if "event" in r]
+        assert "dispatch_wedged" in events
+        assert "thread_quarantined" in events
+        assert "breaker_open" in events and "breaker_closed" in events
+        states = [r for r in recs if r.get("event") == "serving_state"]
+        assert any(r["state"] == "degraded" for r in states)
+        assert any(r["state"] == "healthy" for r in states)
+        # the final write_snapshot line carries the resilience counters
+        snap_recs = [r for r in recs if r.get("kind") == "serving"]
+        assert snap_recs[-1]["resilience"]["wedged"] == 1
+        # no leaked threads once the 1.2s hang releases the
+        # quarantined worker
+        assert not _no_leaked_workers(before)
+
+
+class TestChaosDrills:
+    def test_chaos_soak(self, small_setup):
+        """ISSUE-7 satellite: randomized raise/hang plans (fixed seed)
+        at serve.request / serve.dispatch_exec / engine.compile through
+        the full resilience stack — no stranded futures, exact
+        accounting, abandoned_inflight == 0, breaker open ->
+        half-open -> closed round-trip, and the clean recovery round
+        back at the documented executable count."""
+        cfg, variables = small_setup
+        from raft_tpu.cli.serve_bench import run_chaos_drill
+
+        summary = run_chaos_drill(
+            variables, cfg, shapes=SHAPES, rounds=2, requests=8,
+            submitters=2, bucket_batch=BUCKET_BATCH, iters=1,
+            dispatch_timeout_s=0.4, hang_s=0.8, breaker_failures=1,
+            breaker_backoff_s=0.15, breaker_backoff_max_s=0.6,
+            recover_s=30.0, seed=7)
+        assert summary["violations"] == []
+        # the drill actually exercised the machinery it claims to
+        assert summary["totals"]["wedged_dispatches"] >= 1
+        assert summary["totals"]["quarantined_threads"] >= 1
+        assert summary["breaker_transitions"]["open"] >= 1
+        assert summary["breaker_transitions"]["closed"] >= 1
+        assert summary["executables"] == summary["documented_buckets"]
+        clean = summary["per_round"][-1]
+        assert clean["health_state"] == "healthy"
+        assert clean["served"] == clean["accepted"]
+
+    def test_crash_plan_kills_subprocess_with_drill_code(self):
+        """The crash class can't be asserted in-process (os._exit):
+        drill it as a child — the serving path must die with
+        CRASH_EXIT_CODE (exit-code discipline: the PR-3 supervisor
+        layer owns crash recovery, and it keys on this code)."""
+        repo = os.path.dirname(os.path.dirname(__file__))
+        worker = os.path.join(repo, "tests", "chaos_serve_worker.py")
+        proc = subprocess.run(
+            [sys.executable, worker, "serve.dispatch_exec"],
+            timeout=120, cwd=repo,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": repo})
+        assert proc.returncode == faults.CRASH_EXIT_CODE
+
+
+class TestFaultScopingUnit:
+    """The ISSUE-7 faults.py extensions: per-site probability and
+    nth-call/count scoping (the chaos plans' vocabulary)."""
+
+    def test_count_scopes_total_fires(self):
+        faults.arm([{"site": "c", "kind": "raise", "at": 2,
+                     "count": 2}])
+        faults.fault_point("c")                  # occurrence 1: early
+        for _ in range(2):                       # occurrences 2, 3 fire
+            with pytest.raises(faults.FaultInjected):
+                faults.fault_point("c")
+        faults.fault_point("c")                  # exhausted
+        assert not faults.armed("c")
+
+    def test_probability_is_plan_seeded_and_reproducible(self):
+        def fires(seed):
+            faults.arm({"seed": seed, "faults": [
+                {"site": "p", "kind": "raise", "p": 0.4, "count": 0}]})
+            n = 0
+            for _ in range(200):
+                try:
+                    faults.fault_point("p")
+                except faults.FaultInjected:
+                    n += 1
+            return n
+
+        a, b = fires(3), fires(3)
+        assert a == b                  # same plan+seed => same fires
+        assert 40 < a < 160            # p=0.4 over 200 calls
+        assert fires(4) != a or fires(5) != a
+
+    def test_unlimited_count_keeps_firing(self):
+        faults.arm([{"site": "u", "kind": "raise", "count": 0}])
+        for _ in range(5):
+            with pytest.raises(faults.FaultInjected):
+                faults.fault_point("u")
+        assert faults.armed("u")
+
+    def test_invalid_p_and_count_rejected(self):
+        with pytest.raises(ValueError, match="p="):
+            faults.arm([{"site": "x", "kind": "raise", "p": 0.0}])
+        with pytest.raises(ValueError, match="p="):
+            faults.arm([{"site": "x", "kind": "raise", "p": 1.5}])
+        with pytest.raises(ValueError, match="count="):
+            faults.arm([{"site": "x", "kind": "raise", "count": -1}])
+
+
 class TestServingMetricsUnit:
     def test_histogram_ladder_and_percentiles(self):
         from raft_tpu.serving.metrics import LatencyHistogram
@@ -478,3 +956,41 @@ class TestServingMetricsUnit:
 
         with pytest.raises(ValueError, match="no metrics path"):
             ServingMetrics().write_snapshot()
+
+    def test_resilience_counters_schema_and_events(self, tmp_path):
+        """ISSUE-7 satellite: quarantined-thread and
+        breaker-transition counters ride the same snapshot, and the
+        transitions append as supervisor-style events to the same
+        metrics.jsonl the dashboard tails."""
+        from raft_tpu.serving.metrics import ServingMetrics
+
+        path = str(tmp_path / "metrics.jsonl")
+        m = ServingMetrics(path)
+        m.record_wedge("3x32x32", failed=2, timeout_s=0.4)
+        m.record_quarantined("3x32x32", alive=1)
+        m.record_breaker_transition("32x32", "closed", "open")
+        m.record_breaker_transition("32x32", "open", "half_open")
+        m.record_breaker_transition("32x32", "half_open", "closed")
+        m.record_state_change("healthy", "degraded", "breaker open")
+        m.record_circuit_rejected(3)
+        rec = m.write_snapshot(executables=1)
+        res = rec["resilience"]
+        assert res["wedged"] == 1
+        assert res["quarantined_threads"] == 1
+        assert res["circuit_rejected"] == 3
+        assert res["breaker_transitions"] == {"open": 1,
+                                              "half_open": 1,
+                                              "closed": 1}
+        assert rec["failed"] == 2      # the wedge failed its futures
+        lines = [json.loads(line) for line in open(path)]
+        events = [r for r in lines if r.get("kind") == "serving_event"]
+        assert [e["event"] for e in events] == [
+            "dispatch_wedged", "thread_quarantined", "breaker_open",
+            "breaker_half_open", "breaker_closed", "serving_state"]
+        for e in events:
+            assert "time" in e     # the supervisor event contract
+        assert events[0]["bucket"] == "3x32x32"
+        assert events[-1] == {**events[-1], "state": "degraded",
+                              "previous": "healthy"}
+        # events without a path are a no-op, not an error
+        ServingMetrics().record_event("x")

@@ -171,12 +171,17 @@ def _build_engine_bucketed():
 def _build_scheduler_coalesce():
     def build():
         ensure_cpu()
+        import random
         import threading
+        import time
 
         import numpy as np
 
         from raft_tpu.serving.engine import RAFTEngine
+        from raft_tpu.serving.resilience import (CircuitOpen,
+                                                 DispatchWedged)
         from raft_tpu.serving.scheduler import MicroBatchScheduler
+        from raft_tpu.testing import faults
 
         variables, cfg = _engine_weights()
         h, w = _IMAGE_HW
@@ -187,8 +192,20 @@ def _build_scheduler_coalesce():
                          envelope=[(2, h, w)], precompile=True,
                          warm_start=True)
         results = []
+        # resilience knobs armed: the second leg below wedges a
+        # dispatch and the H3 count must hold THROUGH drop + recompile
+        # backoff is sized ABOVE the recompile (~10s CPU): the probe
+        # that recompiles will itself wedge on the 0.5s watchdog, but
+        # its quarantined thread's compile still lands (first-insert-
+        # wins) — a long backoff means the next probe finds it ready
+        # instead of churning a compile storm
         with MicroBatchScheduler(eng, max_batch=2,
-                                 gather_window_s=0.05) as sched:
+                                 gather_window_s=0.05,
+                                 dispatch_timeout_s=0.5,
+                                 breaker_failures=1,
+                                 breaker_backoff_s=8.0,
+                                 breaker_backoff_max_s=12.0,
+                                 breaker_rng=random.Random(0)) as sched:
             def caller(seed):
                 rng = np.random.RandomState(seed)
                 futs = [sched.submit(
@@ -203,14 +220,47 @@ def _build_scheduler_coalesce():
                 t.start()
             for t in threads:
                 t.join()
-        assert len(results) == 6, "scheduler dropped requests"
+            assert len(results) == 6, "scheduler dropped requests"
+            # resilience leg: wedge one dispatch — the verdict drops
+            # the suspect bucket executable; the breaker's half-open
+            # probe must lazily RECOMPILE it, landing back at the
+            # documented count (no leaked duplicate buckets after
+            # recovery — the H3 invariant through the recovery path)
+            faults.arm([{"site": "serve.request", "kind": "hang",
+                         "hang_s": 2.0}])
+            try:
+                rng = np.random.RandomState(99)
+                doomed = sched.submit(
+                    rng.rand(h, w, 3).astype(np.float32) * 255,
+                    rng.rand(h, w, 3).astype(np.float32) * 255)
+                try:
+                    doomed.result(timeout=60)
+                    raise AssertionError("hung dispatch did not wedge")
+                except DispatchWedged:
+                    pass
+                assert (2, h, w) not in eng._compiled, \
+                    "wedge verdict did not drop the suspect bucket"
+                recovered = None
+                t_end = time.monotonic() + 120
+                while recovered is None and time.monotonic() < t_end:
+                    try:
+                        recovered = sched.submit(
+                            rng.rand(h, w, 3).astype(np.float32) * 255,
+                            rng.rand(h, w, 3).astype(np.float32) * 255
+                        ).result(timeout=120)
+                    except (CircuitOpen, DispatchWedged):
+                        time.sleep(0.05)
+                assert recovered is not None, "no recovery after wedge"
+            finally:
+                faults.disarm()
         texts = tuple(exe.as_text()
                       for exe in eng._compiled.values() if exe)
         return CanaryResult(
             observed_compiles=len(eng._compiled),
             detail=f"micro-batch scheduler, 2 submitters x 3 requests "
                    f"at {h}x{w} (ragged vs the (2,{h},{w}) bucket), "
-                   "warm-start engine",
+                   "warm-start engine; then a wedge verdict drops the "
+                   "bucket and the half-open probe recompiles it",
             hlo_texts=texts)
     return build
 

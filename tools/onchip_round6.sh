@@ -170,6 +170,23 @@ step serve_bench_r6 1800 python -m raft_tpu.cli.serve_bench \
     --bucket-batch 4 --sessions 2 --session-frames 4 \
     --deadline-ms 30000 --gather-ms 20 --log-dir /tmp/raft_serve_r6
 
+# ---- serving resilience: chaos drill against the real device (PR 7) --
+# randomized raise/hang plans at serve.request / serve.dispatch_exec /
+# engine.compile through the dispatch watchdog + per-bucket breakers +
+# drop/recompile recovery, then a clean round; exits nonzero on any
+# invariant violation (stranded futures, accounting identity, health
+# vs breaker board, leaked duplicate buckets). The CPU tier-1 soak
+# proves the LOGIC; this proves it against real device hangs and real
+# recompile times. Timeout/hang are sized for on-chip compiles (a
+# wedge verdict must not fire on a legitimate minutes-long compile);
+# runs AFTER the measurement rungs — a quarantined device thread must
+# not share a window with the A/B pair.
+step serve_chaos_r6 1800 python -m raft_tpu.cli.serve_bench \
+    --shapes 368x496 --requests 24 --submitters 2 --bucket-batch 4 \
+    --chaos 2 --dispatch-timeout-ms 120000 --hang-ms 180000 \
+    --breaker-backoff-ms 5000 --breaker-backoff-max-ms 600000 \
+    --recover-s 300 --gather-ms 20 --log-dir /tmp/raft_serve_chaos_r6
+
 # ---- trace the loser's question: where did the fused step's time go ---
 # (only worth a window slot once both A/B rungs have numbers)
 if [ -e "$MARK/bench_g_gruxla" ] && [ -e "$MARK/bench_g_grufused" ]; then
