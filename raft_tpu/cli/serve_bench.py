@@ -13,6 +13,12 @@ Run on the real chip (cvt2trt-ish shapes):
     python -m raft_tpu.cli.serve_bench --shapes 440x1024,368x496 \\
         --requests 48 --submitters 2 --bucket-batch 4
 
+``--wire u8`` / ``--pipeline-depth 2`` / ``--device-state`` arm the
+zero-copy hot path (uint8 wire, pipelined dispatch, device-resident
+session state); the summary line then carries the A/B surface —
+``h2d_bytes_per_req``, ``dispatch_gap_{mean,p50,p99}_ms``,
+``overlap_ratio`` — against a baseline run of the same traffic.
+
 ``--chaos N`` instead runs N rounds of randomized fault plans
 (raise/hang at ``serve.request`` / ``serve.dispatch_exec`` /
 ``engine.compile``, seeded probabilities and nth-call scoping) through
@@ -44,9 +50,13 @@ def _ceil8(x: int) -> int:
 #: three distinct hang/failure surfaces (device call, executor worker,
 #: XLA compile)
 CHAOS_SITES = ("serve.request", "serve.dispatch_exec", "engine.compile")
+#: at pipeline_depth > 1 the blocking fetch moves to the completion
+#: stage — its own hang surface, so pipelined chaos draws it too
+CHAOS_SITES_PIPELINED = CHAOS_SITES + ("serve.fetch",)
 
 
-def chaos_plan(rng: random.Random, hang_s: float = 0.5) -> dict:
+def chaos_plan(rng: random.Random, hang_s: float = 0.5,
+               sites=CHAOS_SITES) -> dict:
     """One randomized-but-deterministic fault plan: per site, maybe an
     entry with randomized kind (raise/hang), first eligible occurrence
     (``at``), fire budget (``count``) and per-call probability
@@ -55,7 +65,7 @@ def chaos_plan(rng: random.Random, hang_s: float = 0.5) -> dict:
     drilled via a subprocess (tests/chaos_serve_worker.py) and by the
     PR-3 supervisor layer."""
     faults = []
-    for site in CHAOS_SITES:
+    for site in sites:
         if rng.random() < 0.25:
             continue  # site spared this round
         faults.append({
@@ -74,6 +84,7 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
               deadline_s=None, max_queue=64, gather_window_s=0.005,
               dispatch_timeout_s=None, breaker_failures=0,
               breaker_backoff_s=0.25, breaker_backoff_max_s=30.0,
+              wire="f32", pipeline_depth=1, session_device_state=False,
               fault_plan=None, recover_s=0.0,
               metrics_path=None, seed=0, engine=None):
     """The drill as a library call (tests reuse it, and may pass a
@@ -101,7 +112,7 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                            for h, w in shapes})
         engine = RAFTEngine(variables, cfg, iters=iters,
                             envelope=envelope, precompile=True,
-                            warm_start=True)
+                            warm_start=True, wire=wire)
     documented = len(engine._compiled)
     sched = MicroBatchScheduler(engine, max_queue=max_queue,
                                 max_batch=bucket_batch,
@@ -111,6 +122,7 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                                 breaker_backoff_s=breaker_backoff_s,
                                 breaker_backoff_max_s=breaker_backoff_max_s,
                                 breaker_rng=random.Random(seed),
+                                pipeline_depth=pipeline_depth,
                                 metrics_path=metrics_path)
     futures = [[] for _ in range(submitters)]
     shed = [0] * submitters
@@ -137,7 +149,8 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     def session_loop(sid):
         rng = np.random.RandomState(seed + 1000 + sid)
         h, w = shapes[sid % len(shapes)]
-        sess = VideoSession(sched, deadline_s=deadline_s)
+        sess = VideoSession(sched, deadline_s=deadline_s,
+                            device_state=session_device_state)
         futs = []
         for _ in range(session_frames + 1):
             try:
@@ -224,7 +237,10 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                  + rec["deadline_missed"] + rec["cancelled"])
     open_buckets = sum(1 for b in health["buckets"].values()
                        if b["state"] != "closed")
+    hot = rec["hot_path"]
     return {
+        "wire": getattr(engine, "wire", "f32"),
+        "pipeline_depth": pipeline_depth,
         "submitted": rec["submitted"],
         "accepted": sum(len(fl) for fl in futures),
         "served": served,
@@ -253,6 +269,13 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
         "breaker_transitions": rec["resilience"]["breaker_transitions"],
         "p50_ms": rec["latency"]["p50_ms"],
         "p99_ms": rec["latency"]["p99_ms"],
+        # hot-path A/B surface: wire bytes + dispatch-gap percentiles
+        # (the --wire / --pipeline-depth rungs compare THESE lines)
+        "h2d_bytes_per_req": hot["h2d_bytes_per_req"],
+        "dispatch_gap_mean_ms": hot["dispatch_gap"]["mean_ms"],
+        "dispatch_gap_p50_ms": hot["dispatch_gap"]["p50_ms"],
+        "dispatch_gap_p99_ms": hot["dispatch_gap"]["p99_ms"],
+        "overlap_ratio": hot["assembly"]["overlap_ratio"],
         "wall_s": round(wall, 3),
         "pairs_per_s": round(total_served / wall, 2) if wall else 0.0,
     }
@@ -283,6 +306,8 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                     breaker_failures=2, breaker_backoff_s=0.15,
                     breaker_backoff_max_s=0.6, recover_s=8.0,
                     gather_window_s=0.0, max_queue=64,
+                    wire="f32", pipeline_depth=1, sessions=0,
+                    session_frames=4, session_device_state=False,
                     deadline_s=None, seed=0, metrics_path=None,
                     engine=None):
     """``rounds`` randomized fault rounds + one clean recovery round
@@ -302,7 +327,8 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                            for h, w in shapes})
         engine = RAFTEngine(variables, cfg, iters=iters,
                             envelope=envelope, precompile=True,
-                            warm_start=True, exact_shapes=True)
+                            warm_start=True, exact_shapes=True,
+                            wire=wire)
     documented = len(engine._compiled)
     per_round = []
     violations = []
@@ -314,10 +340,15 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                   breaker_failures=breaker_failures,
                   breaker_backoff_s=breaker_backoff_s,
                   breaker_backoff_max_s=breaker_backoff_max_s,
+                  pipeline_depth=pipeline_depth, sessions=sessions,
+                  session_frames=session_frames,
+                  session_device_state=session_device_state,
                   recover_s=recover_s, metrics_path=metrics_path,
                   engine=engine)
+    sites = (CHAOS_SITES_PIPELINED if pipeline_depth > 1
+             else CHAOS_SITES)
     for r in range(rounds):
-        plan = chaos_plan(rng, hang_s=hang_s)
+        plan = chaos_plan(rng, hang_s=hang_s, sites=sites)
         s = run_drill(variables, cfg, seed=seed + 17 * r,
                       fault_plan=plan, **common)
         s["round"] = r
@@ -413,6 +444,19 @@ def main(argv=None):
                    help="per-shape recovery-probe budget after "
                         "traffic (drives the half-open probe; --chaos "
                         "default 8s)")
+    p.add_argument("--wire", choices=("f32", "u8"), default="f32",
+                   help="host→device wire format: u8 ships uint8 "
+                        "frames and normalizes on device (~4x fewer "
+                        "H2D bytes; bitwise at integer inputs)")
+    p.add_argument("--pipeline-depth", type=int, default=1,
+                   help="dispatch pipeline stages: 2 assembles+ships "
+                        "batch N+1 while the device computes batch N "
+                        "and moves the blocking fetch to a completion "
+                        "stage (1: historical synchronous path)")
+    p.add_argument("--device-state", action="store_true",
+                   help="video sessions keep flow_low on device "
+                        "between pairs (on-device forward warp) "
+                        "instead of the per-frame D2H→H2D round trip")
     p.add_argument("--log-dir", default=None,
                    help="append the metrics snapshot to "
                         "<log-dir>/metrics.jsonl")
@@ -450,6 +494,9 @@ def main(argv=None):
             gather_window_s=args.gather_ms / 1e3,
             deadline_s=(args.deadline_ms / 1e3 if args.deadline_ms
                         else None),
+            wire=args.wire, pipeline_depth=args.pipeline_depth,
+            sessions=args.sessions, session_frames=args.session_frames,
+            session_device_state=args.device_state,
             max_queue=args.queue, seed=args.seed,
             metrics_path=metrics_path)
         print(json.dumps(summary), flush=True)
@@ -469,6 +516,8 @@ def main(argv=None):
         breaker_backoff_s=args.breaker_backoff_ms / 1e3,
         breaker_backoff_max_s=max(args.breaker_backoff_max_ms,
                                   args.breaker_backoff_ms) / 1e3,
+        wire=args.wire, pipeline_depth=args.pipeline_depth,
+        session_device_state=args.device_state,
         recover_s=args.recover_s,
         metrics_path=metrics_path, seed=args.seed)
     print(json.dumps(summary), flush=True)

@@ -33,3 +33,50 @@ def forward_interpolate(flow: np.ndarray) -> np.ndarray:
     flow_y = interpolate.griddata((x1, y1), dy, (x0, y0),
                                   method="nearest", fill_value=0)
     return np.stack([flow_x, flow_y], axis=-1).astype(np.float32)
+
+
+_FWD_JIT = None
+
+
+def forward_interpolate_device(flow):
+    """On-device forward warp for device-resident session state
+    (``VideoSession(device_state=True)``): scatter each source pixel's
+    flow to its rounded target cell, dropping points that leave the
+    frame (the same validity window as the host path).
+
+    Deliberately CHEAPER than the scipy version, not equivalent: cells
+    no warped point lands in stay ZERO (a locally cold start — always a
+    valid refinement init) instead of being nearest-neighbor filled by
+    ``griddata``'s global query, which has no reasonable on-device
+    form. Non-finite flow rows fail every validity comparison and
+    scatter nothing, so a poisoned previous pair degrades to a full
+    cold start on device — the NaN guard the host path does with
+    ``np.isfinite`` — without ever forcing a D2H sync. Duplicate
+    targets resolve arbitrarily-but-deterministically (XLA scatter),
+    exactly like ``griddata``'s nearest-of-ties.
+
+    ``flow``: (H, W, 2) jax array; returns the same shape/dtype, still
+    on device. Jitted once; each distinct shape compiles a tiny
+    scatter program."""
+    import jax
+    import jax.numpy as jnp
+
+    global _FWD_JIT
+    if _FWD_JIT is None:
+        def _fwd(flow):
+            ht, wd = flow.shape[0], flow.shape[1]
+            y0, x0 = jnp.meshgrid(jnp.arange(ht), jnp.arange(wd),
+                                  indexing="ij")
+            x1 = x0 + flow[..., 0]
+            y1 = y0 + flow[..., 1]
+            valid = ((x1 > 0) & (x1 < wd) & (y1 > 0) & (y1 < ht))
+            xi = jnp.clip(jnp.round(x1).astype(jnp.int32), 0, wd - 1)
+            yi = jnp.clip(jnp.round(y1).astype(jnp.int32), 0, ht - 1)
+            # invalid points target a drop slot past the grid
+            idx = jnp.where(valid, yi * wd + xi, ht * wd)
+            out = jnp.zeros((ht * wd + 1, 2), flow.dtype)
+            out = out.at[idx.reshape(-1)].set(
+                flow.reshape(-1, 2), mode="drop")
+            return out[:ht * wd].reshape(ht, wd, 2)
+        _FWD_JIT = jax.jit(_fwd)
+    return _FWD_JIT(flow)

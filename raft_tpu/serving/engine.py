@@ -36,6 +36,79 @@ SHAPE_ENVELOPE_WINDOWS: List[Tuple[int, int, int]] = [
     (1, 256, 256), (1, 512, 800), (1, 512, 1024)]
 
 
+class PendingBatch:
+    """One in-flight engine dispatch (``infer_batch_async``).
+
+    JAX dispatch is asynchronous: the executable call returns device
+    arrays immediately and the host only blocks when it READS them.
+    ``fetch()`` is that read — the D2H transfer plus the crop back to
+    the request geometry. Splitting it out lets a serving front-end
+    assemble and ship batch N+1 while the device still computes batch N
+    (``MicroBatchScheduler(pipeline_depth=2)``); ``infer_batch`` is
+    exactly ``infer_batch_async(...).fetch()``, so the synchronous path
+    stays bitwise what it always was.
+
+    ``h2d_bytes``: host bytes shipped to the device for this dispatch
+    (padded frames + any host-built flow_init) — the wire-format
+    counter the serving metrics aggregate. ``t_ready``: monotonic time
+    the outputs were known complete (set by ``fetch``); the scheduler's
+    dispatch-gap histogram reads it.
+    """
+
+    __slots__ = ("bucket", "h2d_bytes", "t_ready", "_flow", "_flow_low",
+                 "_crop", "_return_low", "_low_device", "_inputs")
+
+    def __init__(self, flow, flow_low, crop, bucket, h2d_bytes,
+                 return_low, low_device, inputs=None):
+        self._flow = flow
+        self._flow_low = flow_low
+        self._crop = crop           # (b, h, w, top, left, hp, wp)
+        self.bucket = bucket
+        self.h2d_bytes = h2d_bytes
+        self._return_low = return_low
+        self._low_device = low_device
+        #: the call's device input arrays, pinned until fetch: dropping
+        #: the last reference to a DONATED buffer while its computation
+        #: is still in flight makes the deallocation BLOCK on the
+        #: computation (measured ~the full compute time on the CPU
+        #: backend) — exactly the synchronous stall the async split
+        #: exists to remove. fetch() releases them once the results are
+        #: ready, when deletion is free.
+        self._inputs = inputs
+        self.t_ready: Optional[float] = None
+
+    def fetch(self):
+        """Block on the device result; returns what ``infer_batch``
+        would have: flow, or ``(flow, flow_low)`` with return_low.
+        One-shot: the pending's buffer references are released on
+        return (a long-lived PendingBatch — e.g. the scheduler's
+        dispatch-gap clock — must not pin full bucket-padded outputs
+        in device memory)."""
+        if self._flow is None:
+            raise RuntimeError("PendingBatch.fetch() already consumed")
+        # chaos site: a hang here models a device whose compute (or
+        # D2H) never completes — at pipeline_depth>1 this is the
+        # completion stage the scheduler's watchdog must also cover
+        fault_point("serve.fetch")
+        b, h, w, top, left, hp, wp = self._crop
+        flow = np.asarray(
+            self._flow[:b, top:top + h, left:left + w, :])
+        out = flow
+        if self._return_low:
+            # cropped to the ÷8-padded request (NOT the raw frame): the
+            # align padding is identical for the next same-shape frame,
+            # so this feeds straight back as its flow_init
+            low = self._flow_low[:b, :hp // 8, :wp // 8, :]
+            if not self._low_device:
+                low = np.asarray(low)
+            out = (flow, low)
+        self._flow = self._flow_low = None
+        self._inputs = None     # results ready: releasing the donated
+        #                         input buffer no longer blocks
+        self.t_ready = time.monotonic()
+        return out
+
+
 class RAFTEngine:
     """Shape-bucketed AOT engine over converted weights."""
 
@@ -43,7 +116,8 @@ class RAFTEngine:
                  iters: int = ITERS_EXPORT,
                  envelope: Sequence[Tuple[int, int, int]] = (),
                  precompile: bool = True, mesh=None,
-                 exact_shapes: bool = False, warm_start: bool = False):
+                 exact_shapes: bool = False, warm_start: bool = False,
+                 wire: str = "f32"):
         """``mesh``: optional ``jax.sharding.Mesh`` (data × spatial axes,
         `parallel.mesh.make_mesh`) — buckets then compile as SPMD
         programs with batch sharded over 'data' and image height over
@@ -73,12 +147,34 @@ class RAFTEngine:
         still one per bucket. Off by default: the engine-direct
         single-output contract (the exported-``flowup`` analog) is
         unchanged.
+
+        ``wire``: host→device wire format for the frames. ``"f32"``
+        (default) ships fp32 — bitwise the historical path. ``"u8"``
+        compiles bucket executables that take **uint8** frames and run
+        the ``2*(x/255)-1`` normalize on device (models/raft.py already
+        converts via ``astype(float32)``, so the convert lands inside
+        the compiled program): host-side align/zero-fill padding then
+        happens in uint8 (4× cheaper copies) and H2D traffic per
+        request drops ~4×. uint8→fp32 conversion is exact, so at
+        integer-valued [0, 255] inputs the output is bitwise identical
+        to the fp32 wire (pinned in tests/test_serving.py). Float
+        inputs are cast to uint8 on the way in — callers feeding
+        non-integer frames should stay on ``"f32"``. With
+        ``warm_start=True`` the u8 wire also donates the ``flow_init``
+        buffer to its same-shaped ``flow_low`` output (graftaudit H4
+        verifies XLA honors the alias), so a device-resident
+        ``flow_init`` passed at full bucket shape is CONSUMED by the
+        call.
         """
+        if wire not in ("f32", "u8"):
+            raise ValueError(f"wire={wire!r}: choose 'f32' or 'u8'")
         self.config = config
         self.iters = iters
         self.mesh = mesh
         self.exact_shapes = exact_shapes
         self.warm_start = warm_start
+        self.wire = wire
+        self._wire_np = np.uint8 if wire == "u8" else np.float32
         #: guards ``_compiled`` and the weight-tree swap so a live
         #: ``update_weights`` under concurrent dispatch can't mix old
         #: and new weights within one dispatch (each ``infer_batch``
@@ -124,7 +220,17 @@ class RAFTEngine:
                                          iters=iters, test_mode=True)
                 return flow_up
 
-        self._fn = jax.jit(serve)
+        if warm_start and wire == "u8":
+            # the u8 wire's zero-copy discipline extends to the warm
+            # start: flow_init (arg 3) is donated to the same-shaped
+            # flow_low output, so the per-call H2D init buffer is
+            # recycled instead of doubling the 1/8-res state in HBM.
+            # Tied to the wire knob so wire="f32" stays bitwise the
+            # PR-6/7 contract (a donated input is consumed — a
+            # behavior change, however benign).
+            self._fn = jax.jit(serve, donate_argnums=(3,))
+        else:
+            self._fn = jax.jit(serve)
         self._compiled: Dict[Tuple[int, int, int], jax.stages.Compiled] = {}
         for shape in envelope:
             if precompile:
@@ -207,7 +313,10 @@ class RAFTEngine:
             shard = self._in_shard
         else:
             shard = None
-        spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32,
+        # wire="u8" buckets take uint8 frames; the normalize's
+        # astype(float32) then runs ON DEVICE (exact conversion)
+        spec = jax.ShapeDtypeStruct((b, h, w, 3),
+                                    jnp.dtype(self._wire_np),
                                     sharding=shard)
         args = [variables, spec, spec]
         if self.warm_start:
@@ -333,31 +442,36 @@ class RAFTEngine:
 
     # -- inference ----------------------------------------------------------
 
-    def infer_batch(self, image1, image2, flow_init=None,
-                    return_low: bool = False):
-        """(B,H,W,3) float [0,255] -> (B,H,W,2) flow. Routes to a bucket,
-        padding up (raft_trt_utils.pad_images analog); falls back to an
-        exact-shape jit specialization outside the envelope.
+    def infer_batch_async(self, image1, image2, flow_init=None,
+                          return_low: bool = False,
+                          low_device: bool = False) -> PendingBatch:
+        """Non-blocking dispatch: route, pad (in the wire dtype), ship,
+        and CALL the bucket executable — JAX queues the computation and
+        returns device handles immediately. The returned
+        :class:`PendingBatch`'s ``fetch()`` blocks on the result;
+        ``infer_batch`` is ``infer_batch_async(...).fetch()``.
 
-        ``flow_init`` (warm_start engines only): per-sample 1/8-res warm
-        start, shape ``(B, hp//8, wp//8, 2)`` in the ÷8-padded frame
-        space — exactly the ``flow_low`` a previous same-shape call
-        returned (forward-interpolated by the session layer).
-        ``return_low=True`` additionally returns that ``flow_low``.
-
-        Accuracy note: bucket fill beyond the ÷8 pad shifts the encoders'
-        instance-norm statistics, which couple every output pixel to the
-        fill content — measured a few px of pointwise movement with a
-        metric-neutral (<1e-2 px EPE) aggregate at trained weights
-        (tests/test_evaluation.py bucketing-delta test). TensorRT's
-        dynamic shapes don't pay this; exact-shape compile (an envelope
-        bucket per deployed shape) avoids it here."""
+        ``flow_init`` may be a host array (shape ``(B, hp//8, wp//8,
+        2)``, embedded into the bucket on the host as before) or a JAX
+        device array (same shape — embedded into the bucket ON DEVICE,
+        no D2H→H2D round trip; a full-bucket-shaped device array passes
+        through untouched, and on a u8-wire warm engine it is then
+        donated/consumed). ``low_device=True`` leaves the returned
+        ``flow_low`` on device (a lazily-sliced jax array) instead of
+        materializing it to numpy — the session-state round-trip
+        killer."""
         if (flow_init is not None or return_low) and not self.warm_start:
             raise ValueError(
                 "flow_init/return_low need a warm_start=True engine — "
                 "this engine compiled the single-output serving fn")
-        image1 = np.asarray(image1, np.float32)
-        image2 = np.asarray(image2, np.float32)
+        # wire dtype on the HOST side too: with wire="u8" the align/fill
+        # pads below copy uint8 (4× cheaper) and H2D ships 1 byte/px
+        image1 = np.asarray(image1)
+        image2 = np.asarray(image2)
+        if image1.dtype != self._wire_np:
+            image1 = image1.astype(self._wire_np)
+        if image2.dtype != self._wire_np:
+            image2 = image2.astype(self._wire_np)
         b, h, w, _ = image1.shape
         left, right, top, bottom = pad_amounts(h, w)
         hp, wp = h + top + bottom, w + left + right
@@ -377,17 +491,33 @@ class RAFTEngine:
         fill = ((0, bb - b), (0, bh - hp), (0, bw - wp), (0, 0))
         i1 = np.pad(np.pad(image1, align, mode="edge"), fill)
         i2 = np.pad(np.pad(image2, align, mode="edge"), fill)
+        h2d = i1.nbytes + i2.nbytes
         args = [i1, i2]
         if self.warm_start:
-            finit = np.zeros((bb, bh // 8, bw // 8, 2), np.float32)
-            if flow_init is not None:
-                fi = np.asarray(flow_init, np.float32)
-                want = (b, hp // 8, wp // 8, 2)
-                if fi.shape != want:
+            want = (b, hp // 8, wp // 8, 2)
+            full = (bb, bh // 8, bw // 8, 2)
+            if flow_init is not None and isinstance(flow_init, jax.Array):
+                if flow_init.shape == full:
+                    finit = flow_init       # zero-copy pass-through
+                elif flow_init.shape == want:
+                    # embed ON DEVICE: the session's device-resident
+                    # flow_low never touches the host
+                    finit = jnp.zeros(full, jnp.float32).at[
+                        :b, :hp // 8, :wp // 8, :].set(flow_init)
+                else:
                     raise ValueError(
-                        f"flow_init shape {fi.shape} != {want} (1/8 of "
-                        "the ÷8-padded request)")
-                finit[:b, :hp // 8, :wp // 8, :] = fi
+                        f"flow_init shape {flow_init.shape} != {want} "
+                        "(1/8 of the ÷8-padded request)")
+            else:
+                finit = np.zeros(full, np.float32)
+                if flow_init is not None:
+                    fi = np.asarray(flow_init, np.float32)
+                    if fi.shape != want:
+                        raise ValueError(
+                            f"flow_init shape {fi.shape} != {want} "
+                            "(1/8 of the ÷8-padded request)")
+                    finit[:b, :hp // 8, :wp // 8, :] = fi
+                h2d += finit.nbytes
             args.append(finit)
         if self.mesh is not None:
             args = [jax.device_put(a, self._in_shard) for a in args]
@@ -397,14 +527,33 @@ class RAFTEngine:
         if self.warm_start:
             flow_low, flow = out
         else:
-            flow = out
-        flow = np.asarray(flow[:b, top:top + h, left:left + w, :])
-        if return_low:
-            # cropped to the ÷8-padded request (NOT the raw frame): the
-            # align padding is identical for the next same-shape frame,
-            # so this feeds straight back as its flow_init
-            return flow, np.asarray(flow_low[:b, :hp // 8, :wp // 8, :])
-        return flow
+            flow_low, flow = None, out
+        return PendingBatch(flow, flow_low,
+                            (b, h, w, top, left, hp, wp), bucket, h2d,
+                            return_low, low_device, inputs=args)
+
+    def infer_batch(self, image1, image2, flow_init=None,
+                    return_low: bool = False):
+        """(B,H,W,3) [0,255] -> (B,H,W,2) flow. Routes to a bucket,
+        padding up (raft_trt_utils.pad_images analog); falls back to an
+        exact-shape jit specialization outside the envelope.
+
+        ``flow_init`` (warm_start engines only): per-sample 1/8-res warm
+        start, shape ``(B, hp//8, wp//8, 2)`` in the ÷8-padded frame
+        space — exactly the ``flow_low`` a previous same-shape call
+        returned (forward-interpolated by the session layer).
+        ``return_low=True`` additionally returns that ``flow_low``.
+
+        Accuracy note: bucket fill beyond the ÷8 pad shifts the encoders'
+        instance-norm statistics, which couple every output pixel to the
+        fill content — measured a few px of pointwise movement with a
+        metric-neutral (<1e-2 px EPE) aggregate at trained weights
+        (tests/test_evaluation.py bucketing-delta test). TensorRT's
+        dynamic shapes don't pay this; exact-shape compile (an envelope
+        bucket per deployed shape) avoids it here."""
+        return self.infer_batch_async(image1, image2,
+                                      flow_init=flow_init,
+                                      return_low=return_low).fetch()
 
     def infer(self, images: Sequence[np.ndarray], batch_size: int = 4,
               time_it: bool = False) -> List[np.ndarray]:

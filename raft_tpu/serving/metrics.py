@@ -117,6 +117,17 @@ class ServingMetrics:
         self.circuit_rejected = 0
         self.breaker_transitions = {"open": 0, "half_open": 0,
                                     "closed": 0}
+        # hot-path surface (zero-copy serving): dispatch-gap histogram
+        # (host-observed device-idle bound between consecutive
+        # dispatches — 0 when the next batch shipped before the
+        # previous one's results were even ready), host-assembly vs
+        # device-compute overlap, and the H2D wire-bytes counter the
+        # u8 wire exists to shrink
+        self._gap = LatencyHistogram()
+        self.h2d_bytes = 0
+        self.h2d_requests = 0
+        self._assembly_ms = 0.0
+        self._assembly_overlapped_ms = 0.0
 
     # -- recording --------------------------------------------------------
 
@@ -179,6 +190,26 @@ class ServingMetrics:
     def record_failure(self, n: int = 1) -> None:
         with self._lock:
             self.failed += n
+
+    def record_hot_path(self, gap_ms: Optional[float] = None,
+                        assembly_ms: float = 0.0,
+                        overlapped: bool = False,
+                        h2d_bytes: int = 0, requests: int = 0) -> None:
+        """One dispatch's hot-path sample: ``gap_ms`` — host-observed
+        idle between this dispatch and the previous one's results being
+        ready (None for the first dispatch); ``assembly_ms`` — host
+        stack/pad/ship time, ``overlapped=True`` when it ran while a
+        previous batch was still in flight on the device;
+        ``h2d_bytes``/``requests`` — wire bytes shipped for this
+        micro-batch and how many requests rode them."""
+        with self._lock:
+            if gap_ms is not None:
+                self._gap.observe(gap_ms)
+            self._assembly_ms += assembly_ms
+            if overlapped:
+                self._assembly_overlapped_ms += assembly_ms
+            self.h2d_bytes += h2d_bytes
+            self.h2d_requests += requests
 
     # -- resilience events ------------------------------------------------
 
@@ -287,6 +318,22 @@ class ServingMetrics:
                     "one_per_dispatch_baseline":
                         round(self.dispatches / capacity, 4) if capacity
                         else 0.0,
+                },
+                "hot_path": {
+                    "dispatch_gap": self._gap.snapshot(),
+                    "h2d_bytes": self.h2d_bytes,
+                    "h2d_bytes_per_req":
+                        round(self.h2d_bytes / self.h2d_requests, 1)
+                        if self.h2d_requests else 0.0,
+                    "assembly": {
+                        "total_ms": round(self._assembly_ms, 3),
+                        "overlapped_ms":
+                            round(self._assembly_overlapped_ms, 3),
+                        "overlap_ratio": round(
+                            self._assembly_overlapped_ms
+                            / self._assembly_ms, 4)
+                        if self._assembly_ms else 0.0,
+                    },
                 },
                 "latency": self._latency.snapshot(),
                 "hist_bounds_ms": list(_BOUNDS_MS),

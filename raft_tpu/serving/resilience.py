@@ -182,15 +182,19 @@ class CircuitBreaker:
 
 
 class _DispatchJob:
-    """One supervised dispatch. The executing thread fills ``bucket``
-    (the routed executable shape — the wedge verdict's drop target)
-    and ``batch`` (the taken requests — the wedge verdict's futures to
+    """One supervised dispatch (or, at ``pipeline_depth`` > 1, one
+    pipelined completion). The executing thread fills ``bucket`` (the
+    routed executable shape — the wedge verdict's drop target) and
+    ``batch`` (the taken requests — the wedge verdict's futures to
     fail) as it goes; the supervisor sets ``abandoned`` at the verdict
     so a late-waking thread aborts instead of dispatching into a
-    dropped bucket (which would compile a leaked duplicate)."""
+    dropped bucket (which would compile a leaked duplicate).
+    Completion jobs additionally carry ``key`` (the request shape, for
+    the breaker board) and ``t_start`` (handoff time — the completion
+    watchdog's clock)."""
 
     __slots__ = ("fn", "done", "error", "outcome", "bucket", "batch",
-                 "abandoned")
+                 "abandoned", "key", "t_start")
 
     def __init__(self, fn: Optional[Callable[["_DispatchJob"], None]]):
         self.fn = fn
@@ -200,6 +204,8 @@ class _DispatchJob:
         self.bucket: Optional[Tuple[int, int, int]] = None
         self.batch = None
         self.abandoned = False
+        self.key: Optional[Tuple[int, int]] = None
+        self.t_start: Optional[float] = None
 
 
 class DispatchExecutor:
@@ -257,6 +263,16 @@ class DispatchExecutor:
         job = _DispatchJob(fn)
         self._mailbox.put(job)
         return job
+
+    def enqueue(self, job: _DispatchJob) -> None:
+        """Queue an already-built job on the CURRENT worker. Two users:
+        the pipelined completion stage hands off prebuilt jobs here,
+        and a completion-wedge verdict re-queues the jobs that were
+        parked BEHIND the stuck one — their entries live in the
+        abandoned mailbox (a quarantined worker exits without draining
+        it), so the supervisor must re-queue them on the replacement or
+        their futures strand."""
+        self._mailbox.put(job)
 
     def quarantine_and_replace(self) -> int:
         """Wedge verdict: abandon the stuck worker (Python can't kill

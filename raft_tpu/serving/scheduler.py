@@ -60,8 +60,18 @@ the base semantics above are unchanged until armed):
 Fault drills: every micro-batch passes through the ``serve.request``
 fault site (testing/faults) — ``raise`` fails just that batch's
 futures (the worker survives), ``hang`` models a half-up device. The
-supervised executor adds ``serve.dispatch_exec`` and the engine
-``engine.compile`` — the chaos sites ``serve_bench --chaos`` drives.
+supervised executor adds ``serve.dispatch_exec``, the engine
+``engine.compile``, and the pipelined completion stage ``serve.fetch``
+— the chaos sites ``serve_bench --chaos`` drives.
+
+Hot path (ISSUE 8; knobs default OFF = bitwise the above):
+``pipeline_depth`` > 1 splits dispatch into stages over JAX async
+dispatch (assembly of batch N+1 overlaps device compute of batch N;
+the blocking fetch moves to a supervised completion worker), and a
+``wire="u8"`` engine keeps frames uint8 from ``submit`` intake through
+the host pads to the device (4× fewer H2D bytes, on-device
+normalize). The ``hot_path`` metrics block (dispatch-gap histogram,
+assembly overlap ratio, H2D bytes) proves it.
 
 Observability rides along in :class:`~raft_tpu.serving.metrics.
 ServingMetrics`: per-bucket latency histograms for each stage
@@ -113,15 +123,16 @@ class ServeResult(NamedTuple):
 
 class _Request:
     __slots__ = ("image1", "image2", "key", "flow_init", "want_low",
-                 "future", "t_submit", "deadline")
+                 "low_device", "future", "t_submit", "deadline")
 
     def __init__(self, image1, image2, key, flow_init, want_low,
-                 deadline):
+                 low_device, deadline):
         self.image1 = image1
         self.image2 = image2
         self.key = key                  # (H, W) — the coalescing group
         self.flow_init = flow_init
         self.want_low = want_low
+        self.low_device = low_device    # flow_low stays a device array
         self.future: Future = Future()
         self.t_submit = time.monotonic()
         self.deadline = deadline        # absolute monotonic, or None
@@ -147,6 +158,23 @@ class MicroBatchScheduler:
     (``breaker_backoff_s`` base, ``breaker_backoff_max_s`` cap,
     ``breaker_rng`` injectable for deterministic drills) before the
     half-open probe.
+
+    ``pipeline_depth`` (default 1 — bitwise the historical path): at
+    depth N > 1 the dispatch path splits into stages riding JAX's
+    async dispatch. The dispatcher assembles and SHIPS batch K+1 while
+    the device still computes batch K (``engine.infer_batch_async``),
+    and the blocking D2H fetch + future settling move to a completion
+    stage (its own supervised worker) — up to N batches are in flight,
+    and the dispatch gap between consecutive device calls drops to ~0
+    under load. The deadline/backpressure/accounting contract is
+    unchanged: a handed-off batch is in-flight work (never shed, never
+    deadline-expired), completions settle in dispatch order, and with
+    the watchdog armed a completion exceeding ``dispatch_timeout_s``
+    (a hang in device compute or D2H — the ``serve.fetch`` chaos
+    site) gets the same wedge verdict as a stuck dispatch:
+    consequences first (bucket dropped, breaker opened, completion
+    worker quarantined + replaced, trailing completions re-queued on
+    the replacement), THEN the batch's futures fail ``DispatchWedged``.
     """
 
     def __init__(self, engine, *, max_queue: int = 64, max_batch: int = 8,
@@ -156,6 +184,7 @@ class MicroBatchScheduler:
                  breaker_backoff_s: float = 0.25,
                  breaker_backoff_max_s: float = 30.0,
                  breaker_rng: Optional[random.Random] = None,
+                 pipeline_depth: int = 1,
                  metrics: Optional[ServingMetrics] = None,
                  metrics_path: Optional[str] = None):
         self.engine = engine
@@ -176,6 +205,25 @@ class MicroBatchScheduler:
         self._breakers: Dict[Tuple[int, int], CircuitBreaker] = {}
         self._exec = (DispatchExecutor()
                       if self.dispatch_timeout_s is not None else None)
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        #: pipelined completion stage: a second supervised worker owns
+        #: the blocking fetch + settle; ``_pending_jobs`` is the FIFO
+        #: of handed-off-but-unsettled batches (head == the one the
+        #: completion worker is on — the watchdog's verdict target)
+        self._completion = (DispatchExecutor("MicroBatchScheduler-compl")
+                            if self.pipeline_depth > 1 else None)
+        self._pipe_lock = threading.Lock()
+        self._pending_jobs: Deque[_DispatchJob] = collections.deque()
+        #: previous dispatch's PendingBatch — the dispatch-gap clock
+        #: (its ``t_ready`` is None while the batch is still in flight,
+        #: which IS the perfect-overlap reading: gap 0)
+        self._prev_pending = None
+        #: the engine's wire dtype: keep frames in it end-to-end so a
+        #: u8 wire never widens on the host (submit → stack → pad →
+        #: H2D all ride uint8)
+        self._wire_np = (np.uint8
+                         if getattr(engine, "wire", "f32") == "u8"
+                         else np.float32)
         # guards the _health_state compare-and-set + event emit:
         # refreshes race in from the dispatcher, submitters (breaker
         # transitions), and health() callers, and an unsynchronized
@@ -193,14 +241,27 @@ class MicroBatchScheduler:
 
     def submit(self, image1, image2, *, deadline_s: Optional[float] = None,
                flow_init: Optional[np.ndarray] = None,
-               want_low: bool = False) -> Future:
+               want_low: bool = False, low_device: bool = False) -> Future:
         """Enqueue ONE ``(H, W, 3)`` frame pair; returns a Future
         resolving to :class:`ServeResult`. Raises
         :class:`BackpressureError` when the queue is full,
         :class:`CircuitOpen` when the shape's breaker is open, and
-        :class:`SchedulerClosed` after ``close()``."""
-        image1 = np.asarray(image1, np.float32)
-        image2 = np.asarray(image2, np.float32)
+        :class:`SchedulerClosed` after ``close()``.
+
+        ``flow_init`` may be a host array (validated here, embedded on
+        the host) or a device array the engine itself produced
+        (``low_device=True`` results) — the device path never round-
+        trips through host memory. ``low_device=True`` makes the
+        result's ``flow_low`` a device array too."""
+        image1 = np.asarray(image1)
+        image2 = np.asarray(image2)
+        # frames ride the engine's wire dtype from intake on: with a
+        # u8-wire engine every downstream copy (stack, pad) moves 1
+        # byte/px instead of 4, and the host never widens
+        if image1.dtype != self._wire_np:
+            image1 = image1.astype(self._wire_np)
+        if image2.dtype != self._wire_np:
+            image2 = image2.astype(self._wire_np)
         if image1.ndim != 3 or image1.shape[-1] != 3:
             raise ValueError(
                 f"submit takes one (H, W, 3) frame pair, got "
@@ -213,24 +274,37 @@ class MicroBatchScheduler:
             raise ValueError(
                 "flow_init/want_low need a warm_start=True engine")
         if flow_init is not None:
-            flow_init = np.asarray(flow_init, np.float32)
             h, w = image1.shape[:2]
             left, right, top, bottom = pad_amounts(h, w)
             want = ((h + top + bottom) // 8, (w + left + right) // 8, 2)
-            if flow_init.shape != want:
-                # validated HERE so a malformed warm start fails ITS
-                # caller alone — at dispatch time the row assignment
-                # would throw inside the shared try and fail (or, if
-                # broadcastable, silently corrupt) the whole coalesced
-                # micro-batch, other callers included
-                raise ValueError(
-                    f"flow_init shape {flow_init.shape} != {want} (1/8 "
-                    "of the ÷8-padded frame)")
-            if not np.isfinite(flow_init).all():
-                # a NaN warm start would only poison this caller's own
-                # row, but fail it here with a cause instead of
-                # returning NaN flow from the device
-                raise ValueError("flow_init contains non-finite values")
+            if isinstance(flow_init, np.ndarray) \
+                    or not hasattr(flow_init, "shape"):
+                flow_init = np.asarray(flow_init, np.float32)
+                if flow_init.shape != want:
+                    # validated HERE so a malformed warm start fails ITS
+                    # caller alone — at dispatch time the row assignment
+                    # would throw inside the shared try and fail (or, if
+                    # broadcastable, silently corrupt) the whole
+                    # coalesced micro-batch, other callers included
+                    raise ValueError(
+                        f"flow_init shape {flow_init.shape} != {want} "
+                        "(1/8 of the ÷8-padded frame)")
+                if not np.isfinite(flow_init).all():
+                    # a NaN warm start would only poison this caller's
+                    # own row, but fail it here with a cause instead of
+                    # returning NaN flow from the device
+                    raise ValueError(
+                        "flow_init contains non-finite values")
+            else:
+                # device-resident warm start: shape-check without a
+                # D2H sync. No finiteness read — the device
+                # forward-splat (ops/interp.forward_interpolate_device)
+                # drops non-finite points by construction, so a
+                # poisoned flow degrades to a cold start, not NaN flow
+                if tuple(flow_init.shape) != want:
+                    raise ValueError(
+                        f"flow_init shape {tuple(flow_init.shape)} != "
+                        f"{want} (1/8 of the ÷8-padded frame)")
         key = tuple(image1.shape[:2])
         with self._cv:
             if self._closed:
@@ -251,7 +325,8 @@ class MicroBatchScheduler:
                 "backoff")
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
-        req = _Request(image1, image2, key, flow_init, want_low, deadline)
+        req = _Request(image1, image2, key, flow_init, want_low,
+                       low_device, deadline)
         with self._cv:
             if self._closed:
                 raise SchedulerClosed("scheduler is closed")
@@ -308,6 +383,15 @@ class MicroBatchScheduler:
         if (self.dispatch_timeout_s is not None and t0 is not None
                 and time.monotonic() - t0 > self.dispatch_timeout_s):
             return "wedged"      # verdict due/being handled right now
+        if self._completion is not None \
+                and self.dispatch_timeout_s is not None:
+            with self._pipe_lock:
+                head = (self._pending_jobs[0] if self._pending_jobs
+                        else None)
+                age = (time.monotonic() - head.t_start
+                       if head is not None else 0.0)
+            if age > self.dispatch_timeout_s:
+                return "wedged"  # completion-stage verdict due
         with self._cv:
             breakers = list(self._breakers.values())
         if any(br.peek() != BREAKER_CLOSED for br in breakers):
@@ -337,6 +421,8 @@ class MicroBatchScheduler:
         with self._cv:
             breakers = dict(self._breakers)
             depth = len(self._q)
+        with self._pipe_lock:
+            pending = len(self._pending_jobs)
         t0 = self._inflight_since
         done = self._last_dispatch_done
         return {
@@ -353,7 +439,12 @@ class MicroBatchScheduler:
                                     if done is not None else None),
             "quarantined_threads": self.metrics.quarantined_threads,
             "quarantined_alive": (self._exec.quarantined_alive()
-                                  if self._exec else 0),
+                                  if self._exec else 0)
+            + (self._completion.quarantined_alive()
+               if self._completion else 0),
+            "pending_completions": pending,
+            "completion_worker_alive": (self._completion.worker_alive()
+                                        if self._completion else None),
         }
 
     # -- dispatch loop -----------------------------------------------------
@@ -471,16 +562,38 @@ class MicroBatchScheduler:
                 pass
         return n
 
+    def _await_pipeline_slot(self) -> None:
+        """Block the dispatcher until the pipeline has room for another
+        in-flight batch (bounded depth — backpressure against a slow
+        completion stage), scanning queued deadlines and the completion
+        watchdog while waiting so neither stalls behind the wait."""
+        if self._completion is None:
+            return
+        while True:
+            with self._pipe_lock:
+                n = len(self._pending_jobs)
+            if n < self.pipeline_depth:
+                return
+            self._expiry_scan()
+            self._check_completions()
+            time.sleep(0.001)
+
     def _run(self) -> None:
         while True:
             with self._cv:
                 while not self._q and not self._closed:
                     self._cv.wait(timeout=0.05)
-                if not self._q:
-                    if self._closed:
-                        return
-                    continue
-                key = self._q[0].key
+                    if self._completion is not None:
+                        break   # idle tick: run the completion watchdog
+                key = self._q[0].key if self._q else None
+                closed = self._closed
+            if self._completion is not None:
+                self._check_completions()
+            if key is None:
+                if closed:
+                    return
+                continue
+            self._await_pipeline_slot()
             br = self._breaker(key)
             if br is not None and br.state() == BREAKER_OPEN:
                 # queued work behind an open breaker fails fast —
@@ -510,6 +623,8 @@ class MicroBatchScheduler:
             poll = min(0.02, timeout / 4)
             while not job.done.wait(poll):
                 self._expiry_scan()
+                if self._completion is not None:
+                    self._check_completions()
                 if time.monotonic() - self._inflight_since > timeout:
                     self._wedge_verdict(key, job)
                     return
@@ -562,6 +677,69 @@ class MicroBatchScheduler:
         self.metrics.record_wedge(label, failed=n, timeout_s=timeout)
         self._refresh_state(f"wedge verdict on {label}")
 
+    def _check_completions(self) -> None:
+        """Completion-stage watchdog (pipeline_depth > 1, watchdog
+        armed): verdict the OLDEST pending completion past the
+        deadline. Only the head — it is the job the completion worker
+        is actually on (FIFO, single worker); trailing jobs age behind
+        it and get their own verdicts on later ticks if the cascade is
+        real."""
+        if self.dispatch_timeout_s is None:
+            return
+        with self._pipe_lock:
+            job = self._pending_jobs[0] if self._pending_jobs else None
+        if job is not None and job.t_start is not None \
+                and time.monotonic() - job.t_start \
+                > self.dispatch_timeout_s:
+            self._wedge_completion(job)
+
+    def _wedge_completion(self, job: _DispatchJob) -> None:
+        """Wedge verdict on a pipelined completion (device compute or
+        D2H that never finishes): same consequences-before-futures-fail
+        ordering as the dispatch-stage verdict, now spanning in-flight
+        batches — drop the suspect executable, open the breaker,
+        quarantine + replace the completion worker (re-queuing the
+        completions parked BEHIND the stuck one so they can't strand),
+        THEN fail the wedged batch."""
+        key = job.key
+        job.abandoned = True   # a late-waking fetch must not settle
+        #                        results or record a breaker success
+        label = f"{key[0]}x{key[1]}"
+        if job.bucket is not None:
+            self.engine.drop_bucket(job.bucket)
+        self._capacity.pop(key, None)
+        br = self._breaker(key)
+        if br is not None:
+            br.record_failure(wedged=True)
+        # snapshot + worker swap + re-queue are one atom under
+        # _pipe_lock, mirroring the handoff atom in _dispatch: no
+        # completion can slip into the dying mailbox between the
+        # trailing snapshot and the replacement spawn
+        with self._pipe_lock:
+            try:
+                self._pending_jobs.remove(job)
+            except ValueError:
+                pass   # completion raced the verdict and finished
+            trailing = list(self._pending_jobs)
+            alive = self._completion.quarantine_and_replace()
+            for t in trailing:
+                # their mailbox entries died with the quarantined
+                # worker's mailbox — re-queue on the replacement, in
+                # order, with a fresh watchdog stamp (their queue-wait
+                # behind the wedged head must not pre-spend their own
+                # deadline)
+                t.t_start = time.monotonic()
+                self._completion.enqueue(t)
+        self._prev_pending = None   # the wedged fetch never completes:
+        #                             don't pin its buffers (or feed
+        #                             its t_ready to the gap clock)
+        self.metrics.record_quarantined(label, alive=alive)
+        exc = self._wedge_error(key)
+        n = self._fail_requests(list(job.batch or ()), exc)
+        self.metrics.record_wedge(label, failed=n,
+                                  timeout_s=self.dispatch_timeout_s)
+        self._refresh_state(f"completion wedge on {label}")
+
     def _after_dispatch(self, key: Tuple[int, int], job: _DispatchJob
                         ) -> None:
         """Outcome bookkeeping for a dispatch that settled in time."""
@@ -579,6 +757,8 @@ class MicroBatchScheduler:
             self._last_dispatch_done = time.monotonic()
             if br is not None:
                 br.record_success()
+        # "dispatched": handed off to the completion stage — it owns
+        # the breaker outcome (success must mean RESULTS, not enqueue)
         self._refresh_state("dispatch outcome")
 
     def _serve_key(self, key: Tuple[int, int], job: _DispatchJob) -> None:
@@ -617,6 +797,110 @@ class MicroBatchScheduler:
             return
         if batch:
             self._dispatch(key, batch, job)
+
+    def _assemble_flow_init(self, live: List[_Request], key):
+        """The micro-batch's coalesced warm start, or None when every
+        row is cold. Host rows build an np batch (zero rows ARE cold
+        starts); if any row is device-resident the batch assembles ON
+        DEVICE (scatter into device zeros) so session state never
+        round-trips through host memory."""
+        if not any(r.flow_init is not None for r in live):
+            return None
+        h, w = key
+        n = len(live)
+        left, right, top, bottom = pad_amounts(h, w)
+        lh = (h + top + bottom) // 8
+        lw = (w + left + right) // 8
+        if any(r.flow_init is not None
+               and not isinstance(r.flow_init, np.ndarray)
+               for r in live):
+            import jax.numpy as jnp
+            finit = jnp.zeros((n, lh, lw, 2), jnp.float32)
+            for i, r in enumerate(live):
+                if r.flow_init is not None:
+                    finit = finit.at[i].set(r.flow_init)
+            return finit
+        finit = np.zeros((n, lh, lw, 2), np.float32)
+        for i, r in enumerate(live):
+            if r.flow_init is not None:
+                finit[i] = r.flow_init
+        return finit
+
+    def _settle(self, live: List[_Request], outs, label: str,
+                t_disp: float, warm: bool) -> None:
+        """Resolve a finished micro-batch's futures + per-request
+        latency records (inline at depth 1, on the completion worker
+        at depth > 1)."""
+        if warm:
+            flows, lows = outs
+        else:
+            flows, lows = outs, None
+        t_done = time.monotonic()
+        for i, r in enumerate(live):
+            low = None
+            if lows is not None and r.want_low:
+                low = lows[i]
+                if not r.low_device and not isinstance(low, np.ndarray):
+                    low = np.asarray(low)
+            try:
+                r.future.set_result(ServeResult(flows[i], low))
+            except InvalidStateError:
+                continue  # wedge verdict settled it first
+            self.metrics.record_complete(
+                label, queue_ms=(t_disp - r.t_submit) * 1e3,
+                device_ms=(t_done - t_disp) * 1e3)
+
+    def _complete_batch(self, key: Tuple[int, int], label: str,
+                        live: List[_Request], pending, t_disp: float,
+                        warm: bool, job: _DispatchJob) -> None:
+        """Completion stage (pipeline_depth > 1): the blocking fetch +
+        settle, off the dispatch path. Runs on the completion
+        executor's worker; a verdicted (abandoned) job settles nothing
+        and records no breaker outcome."""
+        # the watchdog clock restarts when the worker actually BEGINS
+        # this job: queue-wait behind a slow-but-legal predecessor must
+        # not count against dispatch_timeout_s, or steady traffic at
+        # fetch_time > timeout/depth wedges healthy batches. The stuck
+        # cases still age correctly: a hang in the executor loop's own
+        # fault site (before fn) leaves the handoff stamp running, and
+        # a hang in fetch ages from here.
+        job.t_start = time.monotonic()
+        try:
+            try:
+                outs = pending.fetch()
+            except Exception as exc:
+                if job.abandoned:
+                    return
+                self.metrics.record_failure(
+                    self._fail_requests(live, exc))
+                job.outcome = "failed"
+                br = self._breaker(key)
+                if br is not None:
+                    br.record_failure()
+                self._refresh_state("completion failed")
+                return
+            if job.abandoned:
+                # verdict landed between the fetch returning and here:
+                # the verdict already failed these futures — the
+                # safety-net settle below covers the race where it saw
+                # an empty batch (guards keep accounting exact)
+                n = self._fail_requests(live, self._wedge_error(key))
+                if n:
+                    self.metrics.record_failure(n)
+                return
+            self._settle(live, outs, label, t_disp, warm)
+            job.outcome = "ok"
+            self._last_dispatch_done = time.monotonic()
+            br = self._breaker(key)
+            if br is not None:
+                br.record_success()
+            self._refresh_state("completion outcome")
+        finally:
+            with self._pipe_lock:
+                try:
+                    self._pending_jobs.remove(job)
+                except ValueError:
+                    pass   # a wedge verdict removed it already
 
     def _dispatch(self, key: Tuple[int, int], batch: List[_Request],
                   job: _DispatchJob) -> None:
@@ -658,37 +942,85 @@ class MicroBatchScheduler:
                 self.metrics.record_failure(self._fail_requests(
                     live, self._wedge_error(key)))
                 return
+            warm = getattr(self.engine, "warm_start", False)
+            prev = self._prev_pending
+            overlapped = prev is not None and prev.t_ready is None
+            t_asm0 = time.monotonic()
             i1 = np.stack([r.image1 for r in live])
             i2 = np.stack([r.image2 for r in live])
-            if getattr(self.engine, "warm_start", False):
-                finit = None
-                if any(r.flow_init is not None for r in live):
-                    left, right, top, bottom = pad_amounts(h, w)
-                    lh = (h + top + bottom) // 8
-                    lw = (w + left + right) // 8
-                    # zero rows are cold starts: warm sessions and
-                    # one-shot requests share the dispatch
-                    finit = np.zeros((n, lh, lw, 2), np.float32)
-                    for i, r in enumerate(live):
-                        if r.flow_init is not None:
-                            finit[i] = r.flow_init
-                flows, lows = self.engine.infer_batch(
-                    i1, i2, flow_init=finit, return_low=True)
+            finit = self._assemble_flow_init(live, key) if warm else None
+            call_async = getattr(self.engine, "infer_batch_async", None)
+            if call_async is None:
+                # duck-typed engine without the async API: synchronous
+                # call, settled inline (no pipelining, no gap stats)
+                self._prev_pending = None
+                if warm:
+                    outs = self.engine.infer_batch(
+                        i1, i2, flow_init=finit, return_low=True)
+                else:
+                    outs = self.engine.infer_batch(i1, i2)
+                self._settle(live, outs, label, t_disp, warm)
+                job.outcome = "ok"
+                return
+            if warm:
+                low_dev = any(r.want_low and r.low_device for r in live)
+                pending = call_async(i1, i2, flow_init=finit,
+                                     return_low=True,
+                                     low_device=low_dev)
             else:
-                flows = self.engine.infer_batch(i1, i2)
-                lows = None
-            t_done = time.monotonic()
-            for i, r in enumerate(live):
-                low = lows[i] if (lows is not None and r.want_low) \
-                    else None
-                try:
-                    r.future.set_result(ServeResult(flows[i], low))
-                except InvalidStateError:
-                    continue  # wedge verdict settled it first
-                self.metrics.record_complete(
-                    label, queue_ms=(t_disp - r.t_submit) * 1e3,
-                    device_ms=(t_done - t_disp) * 1e3)
-            job.outcome = "ok"
+                pending = call_async(i1, i2)
+            # hot-path sample: gap = host-observed device idle before
+            # this dispatch (0 when we shipped before the previous
+            # batch's results were even ready — perfect overlap)
+            t_call_end = time.monotonic()
+            gap_ms = None
+            if prev is not None:
+                gap_ms = (0.0 if prev.t_ready is None
+                          else max(0.0, (t_call_end - prev.t_ready)
+                                   * 1e3))
+            self.metrics.record_hot_path(
+                gap_ms=gap_ms, assembly_ms=(t_call_end - t_asm0) * 1e3,
+                overlapped=overlapped, h2d_bytes=pending.h2d_bytes,
+                requests=n)
+            self._prev_pending = pending
+            if job.abandoned:
+                # a wedge verdict landed while the engine call was out
+                # (hung compile that eventually returned): the verdict
+                # already failed these futures, dropped the bucket and
+                # opened the breaker — handing off now would record a
+                # completion SUCCESS that closes the breaker the
+                # verdict just opened. Settle any stragglers and stop.
+                n = self._fail_requests(live, self._wedge_error(key))
+                if n:
+                    self.metrics.record_failure(n)
+                return
+            if self._completion is None:
+                self._settle(live, pending.fetch(), label, t_disp, warm)
+                job.outcome = "ok"
+                return
+            # pipelined handoff: the blocking fetch + settle move to
+            # the completion worker; the dispatcher is free to assemble
+            # the next micro-batch while the device computes this one
+            cjob = _DispatchJob(
+                lambda j, key=key, label=label, live=live,
+                pending=pending, t_disp=t_disp, warm=warm:
+                self._complete_batch(key, label, live, pending,
+                                     t_disp, warm, j))
+            cjob.key = key
+            cjob.bucket = bucket
+            cjob.batch = live
+            cjob.t_start = time.monotonic()
+            # append + mailbox enqueue are one atom under _pipe_lock:
+            # a concurrent completion-wedge verdict swaps the mailbox
+            # under the same lock, so a handoff lands either fully
+            # before the swap (re-queued with the trailing jobs) or
+            # fully after (queued on the replacement) — never into the
+            # dead mailbox
+            with self._pipe_lock:
+                self._pending_jobs.append(cjob)
+                self._completion.enqueue(cjob)
+            job.outcome = "dispatched"   # the completion stage owns
+            #                              the breaker verdict now
         except Exception as exc:  # route to the callers; worker survives
             self.metrics.record_failure(self._fail_requests(live, exc))
             job.outcome = "failed"
@@ -735,6 +1067,23 @@ class MicroBatchScheduler:
             raise RuntimeError(
                 "supervised dispatch executor failed to stop within "
                 f"{timeout}s")
+        if self._completion is not None:
+            # handed-off batches are in-flight work: wait them out
+            # (wedging any overdue one when the watchdog is armed —
+            # the dispatcher that normally runs the scan is gone)
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._pipe_lock:
+                    n = len(self._pending_jobs)
+                if not n:
+                    break
+                self._check_completions()
+                time.sleep(0.005)
+            if not self._completion.close(
+                    max(0.1, deadline - time.monotonic())):
+                raise RuntimeError(
+                    "completion stage failed to drain within "
+                    f"{timeout}s")
         if first and self.metrics.path:
             self.metrics.write_snapshot(
                 executables=self.executable_count())

@@ -36,9 +36,22 @@ class VideoSession:
     """
 
     def __init__(self, scheduler, *, warm_start: bool = True,
+                 device_state: bool = False,
                  deadline_s: Optional[float] = None):
+        """``device_state=True`` keeps the recurrence state
+        (``flow_low``) ON DEVICE between pairs: the scheduler returns a
+        device array, the forward warp runs as a jitted scatter
+        (ops/interp.forward_interpolate_device — holes stay zero, i.e.
+        locally cold, instead of scipy's global nearest fill), and the
+        next submit passes the device array straight back — the
+        per-frame D2H→H2D round trip disappears from the hot path.
+        Shape-change and cold-restart paths still materialize to host
+        (they reset the state to None and restart the recurrence);
+        ``drain()`` always returns a host array. Default OFF: the host
+        scipy path is bitwise what it always was."""
         self._sched = scheduler
         self.warm_start = bool(warm_start)
+        self.device_state = bool(device_state)
         self.deadline_s = deadline_s
         self.frames = 0
         self.warm_submits = 0
@@ -77,10 +90,24 @@ class VideoSession:
         flow_init = None
         if self.warm_start:
             self._harvest()
-            if self._flow_low is not None:
+            if self._flow_low is not None and self.device_state \
+                    and not isinstance(self._flow_low, np.ndarray):
+                from raft_tpu.ops.interp import \
+                    forward_interpolate_device
+
+                # device-resident recurrence: warp on device, feed the
+                # handle straight back — no bytes cross the PCIe/host
+                # boundary between pairs. A non-finite flow scatters
+                # nothing (every point fails the validity window), so
+                # a garbage pair degrades to a cold start here the way
+                # the host path's isfinite guard does — without a sync.
+                flow_init = forward_interpolate_device(self._flow_low)
+                self.warm_submits += 1
+            elif self._flow_low is not None:
                 from raft_tpu.ops.interp import forward_interpolate
 
-                flow_init = forward_interpolate(self._flow_low)
+                flow_init = forward_interpolate(
+                    np.asarray(self._flow_low))
                 if np.isfinite(flow_init).all():
                     self.warm_submits += 1
                 else:
@@ -94,12 +121,17 @@ class VideoSession:
             prev, frame,
             deadline_s=self.deadline_s if deadline_s is None
             else deadline_s,
-            flow_init=flow_init, want_low=self.warm_start)
+            flow_init=flow_init, want_low=self.warm_start,
+            low_device=self.device_state)
         self._pending = fut
         return fut
 
     def drain(self) -> Optional[np.ndarray]:
         """Wait out the last pair; returns the stream's final
-        ``flow_low`` (None if the stream is cold)."""
+        ``flow_low`` (None if the stream is cold) — always materialized
+        to host, whatever ``device_state`` says."""
         self._harvest()
+        if self._flow_low is not None \
+                and not isinstance(self._flow_low, np.ndarray):
+            self._flow_low = np.asarray(self._flow_low)
         return self._flow_low

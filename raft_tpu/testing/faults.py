@@ -30,7 +30,11 @@ Plan entries (a list of dicts, or ``{"faults": [...]}``):
     ``engine.compile`` (immediately before a real XLA bucket compile in
     ``RAFTEngine._get_executable`` — cache hits never fire it;
     ``raise`` models an uncompilable shape, ``hang`` a compile that
-    never returns).
+    never returns), ``serve.fetch`` (top of ``PendingBatch.fetch``,
+    serving/engine.py — the blocking D2H read; a hang models a device
+    whose compute or transfer never completes, which at
+    ``pipeline_depth`` > 1 is the COMPLETION stage the scheduler's
+    watchdog must verdict across in-flight batches).
 ``at``
     1-based occurrence at which the entry becomes eligible (default 1).
     With the defaults below, each entry fires exactly once — the

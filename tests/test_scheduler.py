@@ -367,6 +367,65 @@ class TestVideoSessions:
         # (correctly) degrades that pair to a cold start
         assert 1 <= sess.warm_submits <= 2
 
+    def test_device_state_session_keeps_flow_low_on_device(self,
+                                                           engine, rng):
+        """ISSUE-8 satellite: device_state=True carries the recurrence
+        state between pairs as a DEVICE array — the result's flow_low
+        is a jax array, the on-device forward splat feeds it back
+        (warm_submits counts it), and drain() still hands the caller a
+        host array."""
+        import jax
+
+        frames = [rng.rand(32, 32, 3).astype(np.float32) * 255
+                  for _ in range(4)]
+        with MicroBatchScheduler(engine, gather_window_s=0.0) as sched:
+            sess = VideoSession(sched, device_state=True)
+            futs = [sess.submit_frame(f) for f in frames]
+            results = [f.result(timeout=120) for f in futs[1:]]
+            # the state never round-tripped: flow_low rides on device
+            assert all(isinstance(r.flow_low, jax.Array)
+                       for r in results)
+            # the device splat has no blow-out degrade (holes are
+            # locally cold, not NaN), so every chained pair warm-starts
+            assert sess.warm_submits == 2
+            final = sess.drain()
+        assert isinstance(final, np.ndarray)   # drain materializes
+        assert final.shape == (4, 4, 2)
+        assert all(r.flow.shape == (32, 32, 2) for r in results)
+        # no NaN escaped the device recurrence into served flow
+        assert all(np.isfinite(r.flow).all() for r in results)
+
+    def test_device_forward_splat_matches_host_warp_semantics(self):
+        """The on-device forward splat vs the scipy host path on a
+        controlled flow: identical values where a warped point lands
+        (nearest-scatter), zeros in the holes (locally cold instead of
+        griddata's global nearest fill), and NaN input degrades to an
+        all-cold (all-zero) init instead of poisoning the stream."""
+        from raft_tpu.ops.interp import (forward_interpolate,
+                                         forward_interpolate_device)
+
+        # uniform (+1, +1) shift: every interior target receives
+        # exactly the value (1, 1) — no scatter-tie ambiguity — and
+        # the vacated first row/column becomes the hole case
+        flow = np.ones((6, 8, 2), np.float32)
+        dev = np.asarray(forward_interpolate_device(flow))
+        host = forward_interpolate(flow)
+        np.testing.assert_array_equal(dev[1:, 1:],
+                                      np.ones((5, 7, 2), np.float32))
+        np.testing.assert_array_equal(host[1:, 1:],
+                                      np.ones((5, 7, 2), np.float32))
+        # the documented divergence: holes stay ZERO on device
+        # (locally cold), while griddata nearest-fills them
+        np.testing.assert_array_equal(dev[0, :], np.zeros((8, 2)))
+        np.testing.assert_array_equal(dev[:, 0], np.zeros((6, 2)))
+        np.testing.assert_array_equal(host[0, 1:],
+                                      np.ones((7, 2), np.float32))
+        # NaN flow: every point fails the validity window -> all-zero
+        # (cold) init, no host sync, no NaN
+        bad = np.full((6, 8, 2), np.nan, np.float32)
+        dev_bad = np.asarray(forward_interpolate_device(bad))
+        np.testing.assert_array_equal(dev_bad, np.zeros_like(bad))
+
     def test_flow_init_moves_the_refinement_start(self, engine, rng):
         """The warm-start mechanism itself, deterministically: the same
         pair with a nonzero flow_init differs from the cold dispatch
@@ -448,19 +507,45 @@ def _pad8(x):
     return -(-x // 8) * 8
 
 
+class _FakePending:
+    """_FakeEngine's PendingBatch analog: ``fetch_delay_s`` models the
+    device compute the host only waits for at fetch — the deterministic
+    substrate for the pipelining A/B (no XLA timing noise)."""
+
+    def __init__(self, eng, shape, bucket):
+        self._eng = eng
+        self._shape = shape
+        self.bucket = bucket
+        self.h2d_bytes = int(np.prod(shape)) * 2
+        self.t_ready = None
+
+    def fetch(self):
+        faults.fault_point("serve.fetch")
+        if self._eng.fetch_delay_s:
+            time.sleep(self._eng.fetch_delay_s)
+        if self._shape[1:3] in self._eng.fail_fetch_shapes:
+            raise RuntimeError(f"fetch error at {self._shape[1:3]}")
+        out = np.zeros(self._shape[:3] + (2,), np.float32)
+        self.t_ready = time.monotonic()
+        return out
+
+
 class _FakeEngine:
     """Duck-typed engine for fast, deterministic resilience drills:
     per-shape hang/fail behavior without XLA. Mirrors the real engine's
-    scheduler-facing surface (capacity/route/ensure/drop/_compiled)."""
+    scheduler-facing surface (capacity/route/ensure/drop/_compiled +
+    the async dispatch split)."""
 
     warm_start = False
 
-    def __init__(self, infer_delay_s=0.0):
+    def __init__(self, infer_delay_s=0.0, fetch_delay_s=0.0):
         self._compiled = {}
         self.infer_delay_s = infer_delay_s
+        self.fetch_delay_s = fetch_delay_s
         self.compile_calls = 0
         self.hang_shapes = {}     # (h, w) -> sleep seconds in infer
         self.fail_shapes = set()  # (h, w) -> raise in infer
+        self.fail_fetch_shapes = set()  # (h, w) -> raise in fetch
 
     def bucket_capacity(self, h, w):
         hp, wp = _pad8(h), _pad8(w)
@@ -481,7 +566,7 @@ class _FakeEngine:
     def drop_bucket(self, shape):
         return self._compiled.pop(shape, None) is not None
 
-    def infer_batch(self, i1, i2, **kw):
+    def infer_batch_async(self, i1, i2, **kw):
         key = (i1.shape[1], i1.shape[2])
         if key in self.hang_shapes:
             time.sleep(self.hang_shapes[key])
@@ -489,7 +574,11 @@ class _FakeEngine:
             raise RuntimeError(f"device error at {key}")
         if self.infer_delay_s:
             time.sleep(self.infer_delay_s)
-        return np.zeros(i1.shape[:3] + (2,), np.float32)
+        return _FakePending(self, i1.shape, self.route_bucket(
+            i1.shape[0], *key))
+
+    def infer_batch(self, i1, i2, **kw):
+        return self.infer_batch_async(i1, i2, **kw).fetch()
 
 
 def _wait_for(predicate, timeout=10.0, interval=0.02):
@@ -746,6 +835,169 @@ class TestDispatchWatchdog:
                                      + snap["cancelled"])
 
 
+class TestPipelinedDispatch:
+    """ISSUE-8 tentpole (b): pipeline_depth splits dispatch into
+    stages over JAX async dispatch — assembly of batch N+1 overlaps
+    device compute of batch N, the blocking fetch moves to a
+    completion stage, and every PR-6/7 invariant (accounting identity,
+    per-request result routing, wedge verdicts, drain) holds across
+    in-flight batches."""
+
+    def test_depth2_accounting_and_result_routing(self, engine, rng):
+        """Each future gets ITS pair's flow (results cross the
+        completion stage without mixing batches), the accounting
+        identity holds, and the hot-path metrics block lands in the
+        snapshot."""
+        pairs = [_pair(rng, h, w)
+                 for h, w in (SHAPES * 4)[:8]]
+        direct = [engine.infer_batch(i1[None], i2[None])[0]
+                  for i1, i2 in pairs]
+        with MicroBatchScheduler(engine, max_batch=BUCKET_BATCH,
+                                 gather_window_s=0.01,
+                                 pipeline_depth=2) as sched:
+            futs = [sched.submit(i1, i2) for i1, i2 in pairs]
+            res = [f.result(timeout=120) for f in futs]
+            for got, want in zip(res, direct):
+                # batch fill is per-sample neutral (~3e-5 px)
+                np.testing.assert_allclose(got.flow, want, atol=1e-3,
+                                           rtol=1e-4)
+            h = sched.health()
+            assert h["pending_completions"] == 0
+            assert h["completion_worker_alive"] is True
+        snap = sched.metrics.snapshot()
+        assert snap["submitted"] == 8 == snap["completed"]
+        assert snap["submitted"] == (snap["completed"] + snap["failed"]
+                                     + snap["deadline_missed"]
+                                     + snap["cancelled"])
+        assert snap["abandoned_inflight"] == 0
+        hot = snap["hot_path"]
+        assert hot["h2d_bytes"] > 0 and hot["h2d_bytes_per_req"] > 0
+        assert hot["dispatch_gap"]["count"] >= 1
+        assert 0.0 <= hot["assembly"]["overlap_ratio"] <= 1.0
+        # the module invariant: no bucket leaked through the pipeline
+        assert sorted(engine._compiled) == [
+            (BUCKET_BATCH, h, w) for h, w in SHAPES]
+
+    def test_depth2_gap_strictly_below_depth1(self, rng):
+        """THE ISSUE-8 acceptance shape, deterministic: with device
+        compute modeled as a fetch-side delay, depth 2 ships batch
+        N+1 while N still computes — its mean dispatch gap must sit
+        strictly below depth 1's on the same traffic."""
+        gaps = {}
+        for depth in (1, 2):
+            eng = _FakeEngine(fetch_delay_s=0.03)
+            eng.ensure_bucket(1, 32, 32)
+            sched = MicroBatchScheduler(eng, max_batch=1,
+                                        gather_window_s=0.0,
+                                        pipeline_depth=depth)
+            futs = [sched.submit(*_pair(rng)) for _ in range(6)]
+            for f in futs:
+                f.result(timeout=30)
+            sched.close(drain=True)
+            snap = sched.metrics.snapshot()
+            gaps[depth] = snap["hot_path"]["dispatch_gap"]["mean_ms"]
+            assert snap["completed"] == 6
+            if depth == 2:
+                assert snap["hot_path"]["assembly"]["overlap_ratio"] > 0
+        # depth 1 serializes ready->next-dispatch (gap > 0 always);
+        # depth 2 ships during the 30ms compute window -> gap 0 for
+        # every overlapped dispatch
+        assert gaps[2] < gaps[1], gaps
+
+    def test_depth2_wedge_acceptance_and_recovery(self, small_setup,
+                                                  rng):
+        """The PR-7 wedge drill at depth 2, on the real stack: a hang
+        in the COMPLETION stage (serve.fetch — device compute/D2H that
+        never returns) gets the verdict within the timeout, with the
+        consequences-before-futures-fail ordering spanning in-flight
+        batches: bucket dropped, breaker open, completion worker
+        quarantined, THEN DispatchWedged; recovery recompiles and the
+        accounting identity survives — no stranded futures, no
+        abandoned in-flight work."""
+        before = set(threading.enumerate())
+        cfg, variables = small_setup
+        eng = RAFTEngine(variables, cfg, iters=1,
+                         envelope=[(2, 32, 32)], precompile=True,
+                         warm_start=True)
+        faults.arm([{"site": "serve.fetch", "kind": "hang",
+                     "hang_s": 1.5}])
+        sched = MicroBatchScheduler(
+            eng, max_batch=2, gather_window_s=0.0,
+            dispatch_timeout_s=0.4, breaker_failures=1,
+            breaker_backoff_s=0.2, breaker_backoff_max_s=0.2,
+            breaker_rng=random.Random(0), pipeline_depth=2)
+        try:
+            t0 = time.monotonic()
+            wedged = sched.submit(*_pair(rng))
+            with pytest.raises(DispatchWedged,
+                               match="dispatch_timeout_s"):
+                wedged.result(timeout=10)
+            assert time.monotonic() - t0 < 1.3  # verdict, not hang-end
+            # consequences landed before the future failed
+            assert (2, 32, 32) not in eng._compiled
+            h = sched.health()
+            assert h["state"] == "degraded"
+            assert h["buckets"]["32x32"]["state"] in ("open",
+                                                      "half_open")
+            assert h["quarantined_threads"] == 1
+            faults.disarm()
+            # recovery: the half-open probe recompiles the dropped
+            # bucket and serves
+            res = _retry_until_served(sched, rng, timeout=60)
+            assert res is not None and res.flow.shape == (32, 32, 2)
+            assert (2, 32, 32) in eng._compiled
+            assert _wait_for(
+                lambda: sched.health()["state"] == "healthy")
+        finally:
+            faults.disarm()
+            sched.close(drain=True)
+        snap = sched.metrics.snapshot()
+        assert snap["resilience"]["wedged"] == 1
+        assert snap["resilience"]["quarantined_threads"] == 1
+        assert snap["abandoned_inflight"] == 0
+        assert snap["submitted"] == (snap["completed"] + snap["failed"]
+                                     + snap["deadline_missed"]
+                                     + snap["cancelled"])
+        assert not _no_leaked_workers(before)
+
+    def test_depth2_trailing_completion_survives_wedge(self, rng):
+        """A wedged completion must not strand the batches queued
+        BEHIND it: the verdict re-queues them on the replacement
+        worker and they settle normally."""
+        eng = _FakeEngine()
+        eng.ensure_bucket(1, 32, 32)
+        eng.ensure_bucket(1, 40, 40)
+        sched = MicroBatchScheduler(eng, max_batch=1,
+                                    gather_window_s=0.0,
+                                    dispatch_timeout_s=0.3,
+                                    breaker_failures=1,
+                                    breaker_backoff_s=0.2,
+                                    breaker_backoff_max_s=0.2,
+                                    breaker_rng=random.Random(0),
+                                    pipeline_depth=3)
+        # first fetch hangs (fault scoped to one fire); the two
+        # batches behind it ride the SAME completion worker
+        faults.arm([{"site": "serve.fetch", "kind": "hang",
+                     "hang_s": 1.0, "count": 1}])
+        try:
+            doomed = sched.submit(*_pair(rng))
+            ok = [sched.submit(rng.rand(40, 40, 3).astype(np.float32),
+                               rng.rand(40, 40, 3).astype(np.float32))
+                  for _ in range(2)]
+            with pytest.raises(DispatchWedged):
+                doomed.result(timeout=10)
+            for f in ok:
+                assert f.result(timeout=10).flow.shape == (40, 40, 2)
+        finally:
+            faults.disarm()
+            sched.close(drain=True)
+        snap = sched.metrics.snapshot()
+        assert snap["completed"] == 2 and snap["failed"] == 1
+        assert snap["submitted"] == (snap["completed"] + snap["failed"]
+                                     + snap["deadline_missed"]
+                                     + snap["cancelled"])
+
+
 @pytest.fixture(scope="module")
 def resilience_engine(small_setup):
     """Exact-shapes warm-start engine for the real-stack wedge drill:
@@ -850,6 +1102,30 @@ class TestChaosDrills:
         clean = summary["per_round"][-1]
         assert clean["health_state"] == "healthy"
         assert clean["served"] == clean["accepted"]
+
+    def test_chaos_soak_pipelined(self, small_setup):
+        """ISSUE-8: the chaos soak at pipeline_depth=2 — the wedge
+        watchdog, breaker verdicts, and accounting identity must hold
+        with in-flight batches spanning the dispatch and completion
+        stages (plans now draw the serve.fetch site too). No stranded
+        futures, abandoned_inflight == 0, clean-round recovery at the
+        documented executable count."""
+        cfg, variables = small_setup
+        from raft_tpu.cli.serve_bench import run_chaos_drill
+
+        summary = run_chaos_drill(
+            variables, cfg, shapes=SHAPES, rounds=2, requests=8,
+            submitters=2, bucket_batch=BUCKET_BATCH, iters=1,
+            dispatch_timeout_s=0.4, hang_s=0.8, breaker_failures=1,
+            breaker_backoff_s=0.15, breaker_backoff_max_s=0.6,
+            recover_s=30.0, seed=11, pipeline_depth=2)
+        assert summary["violations"] == []
+        assert summary["totals"]["wedged_dispatches"] >= 1
+        assert summary["executables"] == summary["documented_buckets"]
+        clean = summary["per_round"][-1]
+        assert clean["health_state"] == "healthy"
+        assert clean["served"] == clean["accepted"]
+        assert clean["pipeline_depth"] == 2
 
     def test_crash_plan_kills_subprocess_with_drill_code(self):
         """The crash class can't be asserted in-process (os._exit):

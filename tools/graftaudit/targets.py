@@ -92,6 +92,41 @@ def _build_serve():
     return build
 
 
+def _build_serve_u8():
+    def build():
+        jax = ensure_cpu()
+        import jax.numpy as jnp
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.models import RAFT
+
+        cfg = RAFTConfig()
+        model = RAFT(cfg)
+        h, w = _IMAGE_HW
+        # the u8-wire recipe (RAFTEngine(wire="u8", warm_start=True)):
+        # uint8 frame params — the 2*(x/255)-1 normalize's
+        # astype(float32) is then IN the program, so the wire stays
+        # uint8 until the on-device widen (the H2-ish discipline the
+        # dedicated test pins on the param dtypes) — plus the 1/8-res
+        # flow_init warm start, donated to its same-shaped flow_low
+        # output (H4 verifies XLA honors the alias)
+        img = jax.ShapeDtypeStruct((1, h, w, 3), jnp.uint8)
+        finit = jax.ShapeDtypeStruct((1, h // 8, w // 8, 2),
+                                     jnp.float32)
+        variables = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, h, w, 3)),
+                               jnp.zeros((1, h, w, 3)), iters=1))
+
+        def serve(variables, image1, image2, flow_init):
+            flow_low, flow_up = model.apply(
+                variables, image1, image2, iters=_ITERS,
+                flow_init=flow_init, test_mode=True)
+            return flow_low, flow_up
+
+        return serve, (variables, img, img, finit)
+    return build
+
+
 # -- engine canaries ------------------------------------------------------
 
 _ENGINE_WEIGHTS = []   # [(variables, cfg)] — one real init, both canaries
@@ -164,6 +199,56 @@ def _build_engine_bucketed():
             observed_compiles=len(eng._compiled),
             detail=f"bucketed engine, envelope [(2,{h},{w})], "
                    "in-envelope requests at two geometries",
+            hlo_texts=texts)
+    return build
+
+
+def _build_engine_u8_wire():
+    def build():
+        ensure_cpu()
+        import numpy as np
+        from raft_tpu.serving.engine import RAFTEngine
+
+        variables, cfg = _engine_weights()
+        h, w = _IMAGE_HW
+        eng = RAFTEngine(variables, cfg, iters=_ITERS,
+                         envelope=[(2, h, w)], precompile=True,
+                         warm_start=True, wire="u8")
+        rng = np.random.RandomState(0)
+        frames = rng.randint(0, 256, (2, h, w, 3)).astype(np.uint8)
+        frames2 = rng.randint(0, 256, (2, h, w, 3)).astype(np.uint8)
+        flow, low = eng.infer_batch(frames, frames2, return_low=True)
+        warm = eng.infer_batch(frames, frames2, flow_init=low)
+        assert len(eng._compiled) == 1, "u8 wire leaked a bucket"
+        texts = tuple(exe.as_text()
+                      for exe in eng._compiled.values() if exe)
+        # the wire-stays-uint8 invariant, at the artifact: the
+        # executable's entry layout must take u8 frame params — a
+        # host-side widening would surface here as f32[...,3] params
+        # (and 4x the H2D bytes the budgets pin)
+        assert "u8[2,32,32,3]" in texts[0], \
+            "u8-wire executable does not take uint8 frame params"
+        # bitwise parity vs the fp32 wire at integer-valued inputs:
+        # uint8->f32 conversion is exact, so the on-device normalize
+        # sees identical values
+        ref = RAFTEngine(variables, cfg, iters=_ITERS,
+                         envelope=[(2, h, w)], precompile=True,
+                         warm_start=True)
+        rflow, rlow = ref.infer_batch(frames.astype(np.float32),
+                                      frames2.astype(np.float32),
+                                      return_low=True)
+        assert np.array_equal(flow, rflow) and np.array_equal(low, rlow), \
+            "u8 wire is not bitwise the f32 path at integer inputs"
+        rwarm = ref.infer_batch(frames.astype(np.float32),
+                                frames2.astype(np.float32),
+                                flow_init=rlow)
+        assert np.array_equal(warm, rwarm), \
+            "u8 warm start diverged from the f32 path"
+        return CanaryResult(
+            observed_compiles=len(eng._compiled),
+            detail=f"u8-wire warm-start engine at {h}x{w}: uint8 "
+                   "params pinned in the executable, bitwise parity "
+                   "vs the f32 wire, warm round-trip",
             hlo_texts=texts)
     return build
 
@@ -290,6 +375,15 @@ def build_targets() -> List[Target]:
             build=_build_serve(),
             notes="RAFTEngine serving fn shape (weights as argument)"),
         Target(
+            name="serve_u8",
+            build=_build_serve_u8(),
+            donate_argnums=(3,),   # flow_init -> flow_low alias: the
+            #                        u8-wire warm engine donates it and
+            #                        H4 verifies XLA honors the alias
+            notes="u8-wire warm-start serving recipe "
+                  "(RAFTEngine(wire='u8', warm_start=True)): uint8 "
+                  "frames, on-device normalize, donated flow_init"),
+        Target(
             name="engine_exact_ragged",
             kind="canary",
             build=_build_engine_exact_ragged(),
@@ -301,6 +395,14 @@ def build_targets() -> List[Target]:
             build=_build_engine_bucketed(),
             expect_compiles=1,
             notes="envelope routing pads up instead of recompiling"),
+        Target(
+            name="engine_u8_wire",
+            kind="canary",
+            build=_build_engine_u8_wire(),
+            expect_compiles=1,
+            notes="u8 wire: uint8 executable params (no host-side "
+                  "widening), bitwise parity vs f32 at integer "
+                  "inputs, warm-start round-trip"),
         Target(
             name="scheduler_coalesce",
             kind="canary",

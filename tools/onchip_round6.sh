@@ -170,6 +170,29 @@ step serve_bench_r6 1800 python -m raft_tpu.cli.serve_bench \
     --bucket-batch 4 --sessions 2 --session-frames 4 \
     --deadline-ms 30000 --gather-ms 20 --log-dir /tmp/raft_serve_r6
 
+# ---- serving hot path: wire/pipeline A/B on the same traffic (PR 8) --
+# serve_bench_r6 above is the f32/depth-1 baseline; this rung re-runs
+# the SAME traffic with the u8 wire + depth-2 pipelined dispatch (and
+# device-resident session state). Compare the two JSON lines'
+# h2d_bytes_per_req (expect ~0.25x) and dispatch_gap_* (expect ~0 at
+# depth 2 under load) — the on-chip numbers PROFILE.md round 7 wants.
+# Warm-up leg first: the u8 buckets are NEW programs (and the
+# device-state splat/embed jits compile mid-traffic on a cold cache),
+# which would pollute the measured rung's gap histogram with one-off
+# multi-second on-chip compiles; the warm-up populates the persistent
+# compile cache so the measured run is steady-state.
+step serve_wire_r6_warm 1800 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 8 --submitters 2 \
+    --bucket-batch 4 --sessions 2 --session-frames 2 \
+    --deadline-ms 60000 --gather-ms 20 \
+    --wire u8 --pipeline-depth 2 --device-state
+step serve_wire_r6 1800 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 48 --submitters 2 \
+    --bucket-batch 4 --sessions 2 --session-frames 4 \
+    --deadline-ms 30000 --gather-ms 20 \
+    --wire u8 --pipeline-depth 2 --device-state \
+    --log-dir /tmp/raft_serve_wire_r6
+
 # ---- serving resilience: chaos drill against the real device (PR 7) --
 # randomized raise/hang plans at serve.request / serve.dispatch_exec /
 # engine.compile through the dispatch watchdog + per-bucket breakers +
