@@ -29,6 +29,17 @@ identity submitted == completed + failed + deadline_missed + cancelled,
 abandoned_inflight == 0, and health() consistent with the breaker
 board. A final fault-free round proves recovery: health back to
 healthy and the executable count back at the documented bucket count.
+
+``--models basic,small`` lifts the drill one layer: the traffic drives
+a ``ModelRegistry`` (one engine + scheduler + breaker board + metrics
+namespace per model), ``--canary F`` deploys a reweighted canary for
+the first model at fraction F mid-drill and promotes it after traffic,
+and ``--priority-mix I:B`` splits each submitter's requests between
+the interactive and batch classes. The JSON line then carries
+per-model blocks (latency, occupancy, shed, accounting identity PER
+MODEL) and per-priority blocks (latency, shed). With ``--chaos N``
+the rounds draw the ``registry.load`` site too: a failed canary
+deploy must auto-roll-back and never touch live-model traffic.
 """
 
 from __future__ import annotations
@@ -53,6 +64,9 @@ CHAOS_SITES = ("serve.request", "serve.dispatch_exec", "engine.compile")
 #: at pipeline_depth > 1 the blocking fetch moves to the completion
 #: stage — its own hang surface, so pipelined chaos draws it too
 CHAOS_SITES_PIPELINED = CHAOS_SITES + ("serve.fetch",)
+#: registry drills add the model-variant build path: a failed canary
+#: deploy must auto-roll-back without touching live traffic
+CHAOS_SITES_REGISTRY = CHAOS_SITES + ("registry.load",)
 
 
 def chaos_plan(rng: random.Random, hang_s: float = 0.5,
@@ -397,6 +411,408 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
     }
 
 
+def _merged_priority_blocks(variant_snaps):
+    """Aggregate per-priority counters + latency across every variant
+    snapshot (live + canary + retired finals, all models): counters
+    sum, histograms merge on the shared ladder — the per-priority
+    summary block of the registry drill's JSON line."""
+    from raft_tpu.serving.metrics import LatencyHistogram
+
+    out = {}
+    for snap in variant_snaps:
+        for cls, p in (snap.get("priority") or {}).items():
+            agg = out.setdefault(cls, {
+                "submitted": 0, "completed": 0, "shed": 0,
+                "deadline_missed": 0, "_hist": LatencyHistogram()})
+            for k in ("submitted", "completed", "shed",
+                      "deadline_missed"):
+                agg[k] += p[k]
+            agg["_hist"].merge(
+                LatencyHistogram.from_snapshot(p["latency"]))
+    for agg in out.values():
+        h = agg.pop("_hist")
+        agg["p50_ms"] = h.quantile(0.5)
+        agg["p99_ms"] = h.quantile(0.99)
+        agg["mean_ms"] = (round(h.total / h.count, 3) if h.count
+                          else 0.0)
+    return out
+
+
+def _variant_snaps(model_block):
+    """Every variant snapshot in one model's registry-snapshot block."""
+    snaps = [model_block["live"]]
+    if model_block["canary"] is not None:
+        snaps.append(model_block["canary"])
+    return snaps + list(model_block["retired"])
+
+
+def run_registry_drill(models, *, shapes, requests=48, submitters=2,
+                       bucket_batch=4, iters=2, priority_mix=(1, 1),
+                       canary_fraction=0.0, canary_variables=None,
+                       promote=True, deadline_s=None, max_queue=64,
+                       gather_window_s=0.005, dispatch_timeout_s=None,
+                       breaker_failures=0, breaker_backoff_s=0.25,
+                       breaker_backoff_max_s=30.0, wire="f32",
+                       pipeline_depth=1, sessions=0, session_frames=4,
+                       fault_plan=None, metrics_path=None, seed=0,
+                       engines=None, canary_engine=None):
+    """Mixed-model, mixed-priority drill over a ``ModelRegistry``.
+
+    ``models``: list of ``(name, variables, config)`` — each becomes a
+    live model with its own warm-start engine (one bucket per distinct
+    ÷8 request shape), scheduler, breaker board and metrics namespace.
+    ``priority_mix``: (interactive, batch) request counts per cycle
+    of each submitter's traffic ((0, 0) = priority-less).
+    ``canary_fraction`` > 0 deploys ``canary_variables`` as the FIRST
+    model's canary before traffic and promotes it after
+    (``promote=False`` rolls it back) — under an armed ``fault_plan``
+    the deploy may fail, which must auto-roll-back and leave live
+    traffic untouched (asserted via the summary's ``canary`` block).
+    ``engines``/``canary_engine`` inject prebuilt engines so chaos
+    rounds share compiles. Returns the one-line summary dict with
+    per-model and per-priority blocks."""
+    import numpy as np
+
+    from raft_tpu.serving.registry import DeployError, ModelRegistry
+    from raft_tpu.serving.resilience import CircuitOpen, DispatchWedged
+    from raft_tpu.serving.scheduler import (PRIORITY_BATCH,
+                                            PRIORITY_INTERACTIVE,
+                                            BackpressureError,
+                                            DeadlineExceeded)
+    from raft_tpu.serving.session import VideoSession
+    from raft_tpu.testing import faults
+
+    envelope = sorted({(bucket_batch, _ceil8(h), _ceil8(w))
+                       for h, w in shapes})
+    reg = ModelRegistry(metrics_path=metrics_path, max_queue=max_queue,
+                        max_batch=bucket_batch,
+                        gather_window_s=gather_window_s,
+                        dispatch_timeout_s=dispatch_timeout_s,
+                        breaker_failures=breaker_failures,
+                        breaker_backoff_s=breaker_backoff_s,
+                        breaker_backoff_max_s=breaker_backoff_max_s,
+                        breaker_rng=random.Random(seed),
+                        pipeline_depth=pipeline_depth)
+    for name, variables, cfg in models:
+        reg.add_model(name, variables, cfg, iters=iters,
+                      envelope=envelope,
+                      engine=(engines or {}).get(name),
+                      warm_start=True, wire=wire)
+    target = models[0][0]
+    canary = {"requested": canary_fraction > 0, "deployed": False,
+              "version": None, "deploy_failed": None,
+              "leaked_after_failure": False, "resolution": None}
+    accepted = [[] for _ in range(submitters)]   # (future, model, prio)
+    shed = [0] * submitters
+    rejected = [0] * submitters
+    session_stats = {"pairs": 0, "warm": 0, "errors": 0}
+    pi, pb = priority_mix
+    cycle = ([PRIORITY_INTERACTIVE] * int(pi)
+             + [PRIORITY_BATCH] * int(pb))
+
+    def submit_loop(sid):
+        rng = np.random.RandomState(seed + sid)
+        per = requests // submitters + (1 if sid < requests % submitters
+                                        else 0)
+        for k in range(per):
+            h, w = shapes[(sid + k) % len(shapes)]
+            name = models[(sid * 7 + k) % len(models)][0]
+            prio = cycle[k % len(cycle)] if cycle else None
+            i1 = rng.rand(h, w, 3).astype(np.float32) * 255
+            i2 = rng.rand(h, w, 3).astype(np.float32) * 255
+            try:
+                accepted[sid].append(
+                    (reg.submit(i1, i2, model=name, priority=prio,
+                                deadline_s=deadline_s), name, prio))
+            except BackpressureError:
+                shed[sid] += 1
+            except CircuitOpen:
+                rejected[sid] += 1
+
+    def session_loop(sid):
+        rng = np.random.RandomState(seed + 1000 + sid)
+        h, w = shapes[sid % len(shapes)]
+        name = models[sid % len(models)][0]
+        sess = VideoSession(reg, model=name, deadline_s=deadline_s)
+        futs = []
+        for _ in range(session_frames + 1):
+            try:
+                futs.append(sess.submit_frame(
+                    rng.rand(h, w, 3).astype(np.float32) * 255))
+            except (BackpressureError, CircuitOpen):
+                session_stats["errors"] += 1
+        for f in futs:
+            if f is None:
+                continue
+            try:
+                f.result(timeout=600)
+                session_stats["pairs"] += 1
+            except Exception:
+                session_stats["errors"] += 1
+        session_stats["warm"] += sess.warm_submits
+
+    threads = ([threading.Thread(target=submit_loop, args=(s,))
+                for s in range(submitters)]
+               + [threading.Thread(target=session_loop, args=(s,))
+                  for s in range(sessions)])
+    if fault_plan is not None:
+        faults.arm(fault_plan)
+    t0 = time.perf_counter()
+    try:
+        if canary_fraction > 0:
+            # deploy BEFORE traffic: the canary serves its hash slice
+            # of the drill. A build failure (incl. the registry.load
+            # chaos site) must auto-roll-back: live serves 100% and
+            # health shows no canary — the summary pins both.
+            try:
+                canary["version"] = reg.deploy(
+                    target, canary_variables,
+                    canary_fraction=canary_fraction,
+                    engine=canary_engine)
+                canary["deployed"] = True
+            except DeployError as exc:
+                canary["deploy_failed"] = str(exc)[:200]
+                canary["leaked_after_failure"] = (
+                    reg.health()[target]["canary"] is not None)
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        futures_wait([f for fl in accepted for (f, _, _) in fl],
+                     timeout=600)
+        if canary["deployed"]:
+            if promote:
+                canary["resolution"] = reg.promote(target)["mode"]
+            else:
+                reg.rollback(target)
+                canary["resolution"] = "rolled_back"
+        health = reg.health()
+        reg.close(drain=True)
+    finally:
+        if fault_plan is not None:
+            faults.disarm()
+    wall = time.perf_counter() - t0
+
+    snap = reg.snapshot()    # post-close: retired finals included
+    per_model = {}
+    for name, _, _ in models:
+        blk = snap[name]
+        live = blk["live"]
+        per_model[name] = {
+            "submitted": blk["totals"]["submitted"],
+            "completed": blk["totals"]["completed"],
+            "failed": blk["totals"]["failed"],
+            "shed": blk["totals"]["shed"],
+            "evicted": blk["totals"]["evicted"],
+            "deadline_missed": blk["totals"]["deadline_missed"],
+            "cancelled": blk["totals"]["cancelled"],
+            "accounting_ok": blk["accounting_ok"],
+            "abandoned_inflight": sum(
+                s["abandoned_inflight"] for s in _variant_snaps(blk)),
+            "occupancy": live["occupancy"]["mean"],
+            "p50_ms": live["latency"]["p50_ms"],
+            "p99_ms": live["latency"]["p99_ms"],
+            "executables_live": live["executables"],
+            "health_state": health[name]["live"]["health"]["state"],
+        }
+    served = deadline_missed = wedged = circuit = errors = 0
+    stranded = evicted = 0
+    for fl in accepted:
+        for fut, _, _ in fl:
+            if not fut.done():
+                stranded += 1
+                continue
+            try:
+                fut.result(timeout=0)
+                served += 1
+            except DeadlineExceeded:
+                deadline_missed += 1
+            except DispatchWedged:
+                wedged += 1
+            except CircuitOpen:
+                circuit += 1
+            except BackpressureError:
+                # an ACCEPTED future failing backpressure is a
+                # shed-batch-first eviction — by design under a
+                # priority mix, not a dispatch failure
+                evicted += 1
+            except Exception:
+                errors += 1
+    all_snaps = [s for name, _, _ in models
+                 for s in _variant_snaps(snap[name])]
+    total_served = served + session_stats["pairs"]
+    return {
+        "registry": True,
+        "model_names": [name for name, _, _ in models],
+        "submitted": sum(b["submitted"] for b in per_model.values()),
+        "accepted": sum(len(fl) for fl in accepted),
+        "served": served,
+        "shed": sum(shed),
+        "circuit_rejected": sum(rejected),
+        "deadline_missed": deadline_missed,
+        "errors": errors + session_stats["errors"],
+        "failed_wedged": wedged,
+        "failed_circuit": circuit,
+        "failed_evicted": evicted,
+        "stranded": stranded,
+        "accounting_ok": all(b["accounting_ok"]
+                             for b in per_model.values()),
+        "abandoned_inflight": sum(b["abandoned_inflight"]
+                                  for b in per_model.values()),
+        "session_pairs": session_stats["pairs"],
+        "warm_submits": session_stats["warm"],
+        "canary": canary,
+        "models": per_model,
+        "priorities": _merged_priority_blocks(all_snaps),
+        "wall_s": round(wall, 3),
+        "pairs_per_s": round(total_served / wall, 2) if wall else 0.0,
+    }
+
+
+def _registry_round_violations(s: dict) -> list:
+    """The registry chaos-drill invariants, checked after every
+    round: the single-model invariants PER MODEL, plus the canary
+    contract — a failed deploy leaves no canary behind and live
+    traffic keeps serving."""
+    v = []
+    if s["stranded"]:
+        v.append(f"stranded futures: {s['stranded']}")
+    if s["abandoned_inflight"]:
+        v.append(f"abandoned_inflight: {s['abandoned_inflight']}")
+    for name, blk in s["models"].items():
+        if not blk["accounting_ok"]:
+            v.append(f"model {name}: submitted != completed+failed+"
+                     "deadline_missed+cancelled")
+    if (s["canary"]["deploy_failed"] is not None
+            and s["canary"]["leaked_after_failure"]):
+        v.append("failed canary deploy left a canary routing traffic "
+                 "(auto-rollback broken)")
+    return v
+
+
+def run_registry_chaos(models, *, shapes, rounds=2, requests=16,
+                       submitters=2, bucket_batch=3, iters=1,
+                       priority_mix=(1, 1), canary_fraction=0.5,
+                       canary_variables=None, dispatch_timeout_s=0.4,
+                       hang_s=0.8, breaker_failures=2,
+                       breaker_backoff_s=0.15,
+                       breaker_backoff_max_s=0.6,
+                       gather_window_s=0.0, max_queue=64,
+                       deadline_s=None, seed=0, metrics_path=None):
+    """``rounds`` randomized fault rounds + one clean round of the
+    registry drill over SHARED prebuilt engines (compiles amortized
+    across rounds; a new registry per round owns fresh schedulers).
+    Each round attempts a canary deploy for the first model — the
+    plans draw ``registry.load``, so some deploys fail and must
+    auto-roll-back without touching live traffic — then runs
+    mixed-model mixed-priority traffic and resolves the rollout
+    (promote on even rounds, rollback on odd). The clean round must
+    deploy + promote cleanly with per-model accounting identity,
+    zero stranded futures, and per-engine executables back at the
+    documented bucket count."""
+    from raft_tpu.serving.engine import RAFTEngine
+
+    rng = random.Random(seed)
+    envelope = sorted({(bucket_batch, _ceil8(h), _ceil8(w))
+                       for h, w in shapes})
+    # exact_shapes, like run_chaos_drill: a wedge-dropped bucket must
+    # honestly recompile, not hide behind a larger healthy one
+    engines = {name: RAFTEngine(variables, cfg, iters=iters,
+                                envelope=envelope, precompile=True,
+                                warm_start=True, exact_shapes=True)
+               for name, variables, cfg in models}
+    canary_engine = RAFTEngine(canary_variables, models[0][2],
+                               iters=iters, envelope=envelope,
+                               precompile=True, warm_start=True,
+                               exact_shapes=True)
+    # the recovery check covers every engine population the chaos can
+    # wedge — the shared canary engine included, under a reserved key
+    all_engines = dict(engines)
+    all_engines["_canary"] = canary_engine
+    documented = {name: len(eng._compiled)
+                  for name, eng in all_engines.items()}
+    common = dict(shapes=shapes, requests=requests,
+                  submitters=submitters, bucket_batch=bucket_batch,
+                  iters=iters, priority_mix=priority_mix,
+                  canary_fraction=canary_fraction,
+                  canary_variables=canary_variables,
+                  deadline_s=deadline_s, max_queue=max_queue,
+                  gather_window_s=gather_window_s,
+                  dispatch_timeout_s=dispatch_timeout_s,
+                  breaker_failures=breaker_failures,
+                  breaker_backoff_s=breaker_backoff_s,
+                  breaker_backoff_max_s=breaker_backoff_max_s,
+                  metrics_path=metrics_path, engines=engines,
+                  canary_engine=canary_engine)
+    per_round = []
+    violations = []
+    for r in range(rounds):
+        plan = chaos_plan(rng, hang_s=hang_s,
+                          sites=CHAOS_SITES_REGISTRY)
+        if r == 0:
+            # every chaos run proves the auto-rollback contract at
+            # least once: round 0's deploy is FORCED to fail at
+            # registry.load (the randomized entries may or may not
+            # draw the site) — the round then runs live-only and the
+            # violations check pins no-canary-leaked + accounting
+            plan["faults"] = [f for f in plan["faults"]
+                              if f["site"] != "registry.load"]
+            plan["faults"].append({"site": "registry.load",
+                                   "kind": "raise", "at": 1,
+                                   "count": 1})
+        s = run_registry_drill(models, seed=seed + 17 * r,
+                               fault_plan=plan, promote=(r % 2 == 0),
+                               **common)
+        s["round"] = r
+        s["plan"] = plan
+        per_round.append(s)
+        violations += [f"round {r}: {v}"
+                       for v in _registry_round_violations(s)]
+    # clean round at a production-sized watchdog (same reasoning as
+    # run_chaos_drill: a legitimate recompile of a chaos-dropped
+    # bucket must not verdict as a wedge mid-recovery)
+    clean = dict(common, dispatch_timeout_s=max(30.0,
+                                                dispatch_timeout_s))
+    s = run_registry_drill(models, seed=seed + 999, fault_plan=None,
+                           promote=True, **clean)
+    s["round"] = "clean"
+    per_round.append(s)
+    violations += [f"clean round: {v}"
+                   for v in _registry_round_violations(s)]
+    if not s["canary"]["deployed"] or s["canary"]["resolution"] is None:
+        violations.append("clean round: canary deploy/promote did not "
+                          "complete")
+    if s["served"] != s["accepted"]:
+        violations.append("clean round: served != accepted traffic")
+    for name, eng in all_engines.items():
+        if len(eng._compiled) != documented[name]:
+            violations.append(
+                f"model {name}: executables {len(eng._compiled)} != "
+                f"documented {documented[name]} after recovery")
+    totals = {k: sum(p[k] for p in per_round) for k in
+              ("submitted", "served", "shed", "circuit_rejected",
+               "deadline_missed", "failed_wedged", "failed_circuit",
+               "errors")}
+    deploys = {"attempted": sum(1 for p in per_round
+                                if p["canary"]["requested"]),
+               "deployed": sum(1 for p in per_round
+                               if p["canary"]["deployed"]),
+               "auto_rolled_back": sum(
+                   1 for p in per_round
+                   if p["canary"]["deploy_failed"] is not None)}
+    return {
+        "chaos_rounds": rounds,
+        "registry": True,
+        "violations": violations,
+        "documented_buckets": documented,
+        "executables": {name: len(eng._compiled)
+                        for name, eng in all_engines.items()},
+        "deploys": deploys,
+        "totals": totals,
+        "per_round": per_round,
+    }
+
+
 def main(argv=None):
     from raft_tpu.utils.platform import setup_cli
 
@@ -457,6 +873,22 @@ def main(argv=None):
                    help="video sessions keep flow_low on device "
                         "between pairs (on-device forward warp) "
                         "instead of the per-frame D2H→H2D round trip")
+    p.add_argument("--models", default=None,
+                   help="comma list of arch names (basic|small) to "
+                        "serve as independent live models behind a "
+                        "ModelRegistry (one engine/scheduler/metrics "
+                        "namespace per model); the summary line gains "
+                        "per-model and per-priority blocks")
+    p.add_argument("--canary", type=float, default=0.0, metavar="F",
+                   help="with --models: deploy a reweighted canary "
+                        "for the FIRST model at this deterministic "
+                        "request-hash fraction before traffic, and "
+                        "promote it after (same-arch: executables "
+                        "reused via update_weights)")
+    p.add_argument("--priority-mix", default="0:0", metavar="I:B",
+                   help="with --models: interactive:batch request "
+                        "counts per cycle of each submitter's "
+                        "traffic (0:0 = priority-less)")
     p.add_argument("--log-dir", default=None,
                    help="append the metrics snapshot to "
                         "<log-dir>/metrics.jsonl")
@@ -471,13 +903,88 @@ def main(argv=None):
 
     shapes = [tuple(int(v) for v in s.split("x"))
               for s in args.shapes.split(",")]
+    metrics_path = (os.path.join(args.log_dir, "metrics.jsonl")
+                    if args.log_dir else None)
+    tiny = jnp.zeros((1, 64, 64, 3))
+
+    if args.models:
+        # multi-model registry drill: one live model per arch name,
+        # optional canary rollout on the first, mixed priorities
+        arch = {"basic": RAFTConfig(), "small": RAFTConfig(small=True)}
+        models = []
+        for name in args.models.split(","):
+            c = arch.get(name)
+            if c is None:
+                raise SystemExit(f"--models {name!r}: choose from "
+                                 f"{sorted(arch)}")
+            m = RAFT(c)
+            models.append((name, m.init(jax.random.PRNGKey(len(models)),
+                                        tiny, tiny, iters=1), c))
+        try:
+            mix = tuple(int(v) for v in args.priority_mix.split(":"))
+            if len(mix) != 2 or any(v < 0 for v in mix):
+                raise ValueError
+        except ValueError:
+            raise SystemExit(
+                f"--priority-mix {args.priority_mix!r}: expected "
+                "INTERACTIVE:BATCH non-negative counts, e.g. 3:1 "
+                "(0:0 = priority-less)")
+        canary_variables = None
+        if args.canary or args.chaos:
+            # same arch as the first model, different init — the
+            # "new checkpoint" the rollout ships
+            canary_variables = RAFT(models[0][2]).init(
+                jax.random.PRNGKey(97), tiny, tiny, iters=1)
+        if args.chaos:
+            summary = run_registry_chaos(
+                models, shapes=shapes, rounds=args.chaos,
+                requests=args.requests, submitters=args.submitters,
+                bucket_batch=args.bucket_batch, iters=args.iters,
+                priority_mix=mix,
+                canary_fraction=args.canary or 0.5,
+                canary_variables=canary_variables,
+                dispatch_timeout_s=(args.dispatch_timeout_ms / 1e3
+                                    if args.dispatch_timeout_ms
+                                    else 0.4),
+                hang_s=args.hang_ms / 1e3,
+                breaker_failures=args.breaker_failures or 2,
+                breaker_backoff_s=args.breaker_backoff_ms / 1e3,
+                breaker_backoff_max_s=max(args.breaker_backoff_max_ms,
+                                          args.breaker_backoff_ms) / 1e3,
+                gather_window_s=args.gather_ms / 1e3,
+                deadline_s=(args.deadline_ms / 1e3 if args.deadline_ms
+                            else None),
+                max_queue=args.queue, seed=args.seed,
+                metrics_path=metrics_path)
+            print(json.dumps(summary), flush=True)
+            if summary["violations"]:
+                raise SystemExit(1)
+            return
+        summary = run_registry_drill(
+            models, shapes=shapes, requests=args.requests,
+            submitters=args.submitters, bucket_batch=args.bucket_batch,
+            iters=args.iters, priority_mix=mix,
+            canary_fraction=args.canary,
+            canary_variables=canary_variables,
+            sessions=args.sessions, session_frames=args.session_frames,
+            deadline_s=(args.deadline_ms / 1e3 if args.deadline_ms
+                        else None),
+            max_queue=args.queue, gather_window_s=args.gather_ms / 1e3,
+            dispatch_timeout_s=(args.dispatch_timeout_ms / 1e3
+                                if args.dispatch_timeout_ms else None),
+            breaker_failures=args.breaker_failures,
+            breaker_backoff_s=args.breaker_backoff_ms / 1e3,
+            breaker_backoff_max_s=max(args.breaker_backoff_max_ms,
+                                      args.breaker_backoff_ms) / 1e3,
+            wire=args.wire, pipeline_depth=args.pipeline_depth,
+            metrics_path=metrics_path, seed=args.seed)
+        print(json.dumps(summary), flush=True)
+        return
+
     cfg = RAFTConfig(small=args.small)
     model = RAFT(cfg)
     # params are shape-independent: init tiny (infer_bench lesson)
-    tiny = jnp.zeros((1, 64, 64, 3))
     variables = model.init(jax.random.PRNGKey(0), tiny, tiny, iters=1)
-    metrics_path = (os.path.join(args.log_dir, "metrics.jsonl")
-                    if args.log_dir else None)
     if args.chaos:
         summary = run_chaos_drill(
             variables, cfg, shapes=shapes, rounds=args.chaos,

@@ -7,19 +7,27 @@ envelope (``engine.py``), portable StableHLO serialization (``export.py``),
 and the video/batch helpers (``video.py`` = raft_trt_utils.py analog).
 
 Above the engine sits the serving front-end the reference never had:
-an async micro-batching scheduler with deadlines and backpressure
-(``scheduler.py``), per-stream warm-start video sessions
-(``session.py``), the serving metrics surface (``metrics.py``), and
-the resilience layer (``resilience.py``): dispatch watchdog with
-quarantine-and-replace, per-bucket circuit breakers, engine recovery,
-and the ``health()`` surface.
+an async micro-batching scheduler with deadlines, backpressure and
+priority classes (``scheduler.py``), per-stream warm-start video
+sessions (``session.py``), the serving metrics surface
+(``metrics.py``), the resilience layer (``resilience.py``): dispatch
+watchdog with quarantine-and-replace, per-bucket circuit breakers,
+engine recovery, and the ``health()`` surface — and the multi-model
+registry (``registry.py``): versioned engines per named model, canary
+rollout with deterministic hash routing, promote/rollback with zero
+stranded futures.
 """
 
 from raft_tpu.serving.engine import SHAPE_ENVELOPE_LINUX, RAFTEngine
 from raft_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from raft_tpu.serving.registry import (DeployError, ModelRegistry,
+                                       RolloutInProgress, UnknownModel,
+                                       canary_hash_fraction)
 from raft_tpu.serving.resilience import (CircuitBreaker, CircuitOpen,
                                          DispatchExecutor, DispatchWedged)
-from raft_tpu.serving.scheduler import (BackpressureError, DeadlineExceeded,
+from raft_tpu.serving.scheduler import (PRIORITY_BATCH,
+                                        PRIORITY_INTERACTIVE,
+                                        BackpressureError, DeadlineExceeded,
                                         MicroBatchScheduler, SchedulerClosed,
                                         ServeResult)
 from raft_tpu.serving.session import VideoSession
@@ -28,4 +36,7 @@ __all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX", "MicroBatchScheduler",
            "BackpressureError", "DeadlineExceeded", "SchedulerClosed",
            "ServeResult", "VideoSession", "ServingMetrics",
            "LatencyHistogram", "CircuitBreaker", "CircuitOpen",
-           "DispatchExecutor", "DispatchWedged"]
+           "DispatchExecutor", "DispatchWedged", "ModelRegistry",
+           "DeployError", "RolloutInProgress", "UnknownModel",
+           "canary_hash_fraction", "PRIORITY_INTERACTIVE",
+           "PRIORITY_BATCH"]

@@ -238,15 +238,9 @@ class RAFTEngine:
             else:
                 self._compiled.setdefault(shape, None)
 
-    def update_weights(self, variables: Dict) -> None:
-        """Swap checkpoints without invalidating compiled buckets.
-
-        Structure AND leaf shapes/dtypes must match the engine's current
-        weights — the executables were compiled against those avals, so a
-        same-structure checkpoint with different shapes (e.g. a basic
-        checkpoint into a small-config engine, or bf16-cast weights)
-        would brick every precompiled bucket with an opaque call-time
-        error if it slipped through here."""
+    def _check_weights(self, variables: Dict) -> None:
+        """Raise ``ValueError`` unless ``variables`` matches the
+        engine's weight tree in structure AND leaf shapes/dtypes."""
         old_def = jax.tree_util.tree_structure(self.variables)
         new_def = jax.tree_util.tree_structure(variables)
         if old_def != new_def:
@@ -270,6 +264,38 @@ class RAFTEngine:
                     for k in old.keys() & new.keys() if old[k] != new[k]]
             raise ValueError(
                 "checkpoint structure mismatch: " + "; ".join(diff[:5]))
+
+    def compatible_weights(self, variables: Dict) -> bool:
+        """True iff ``variables`` could be swapped in live via
+        :meth:`update_weights` (same pytree structure and leaf
+        shapes/dtypes as this engine's weights). The registry's
+        same-arch test: a compatible canary promotes as a weight swap
+        that reuses every compiled bucket; an incompatible one (a
+        different architecture) needs a fresh engine."""
+        try:
+            self._check_weights(variables)
+        except ValueError:
+            return False
+        return True
+
+    def bucket_shapes(self) -> List[Tuple[int, int, int]]:
+        """Sorted bucket shapes this engine owns (compiled or
+        ``precompile=False`` placeholders) — e.g. the envelope a
+        canary engine pre-warms so it serves the same request
+        geometries as the live engine it shadows."""
+        with self._lock:
+            return sorted(self._compiled)
+
+    def update_weights(self, variables: Dict) -> None:
+        """Swap checkpoints without invalidating compiled buckets.
+
+        Structure AND leaf shapes/dtypes must match the engine's current
+        weights — the executables were compiled against those avals, so a
+        same-structure checkpoint with different shapes (e.g. a basic
+        checkpoint into a small-config engine, or bf16-cast weights)
+        would brick every precompiled bucket with an opaque call-time
+        error if it slipped through here."""
+        self._check_weights(variables)
         staged = (jax.device_put(variables, self._rep)
                   if self.mesh is not None
                   else jax.device_put(variables))

@@ -55,6 +55,37 @@ class LatencyHistogram:
         if ms > self.max:
             self.max = ms
 
+    @classmethod
+    def from_snapshot(cls, snap: Dict,
+                      bounds: Optional[List[float]] = None
+                      ) -> "LatencyHistogram":
+        """Rebuild a histogram from a :meth:`snapshot` dict (the jsonl
+        form) so post-hoc consumers can :meth:`merge` blocks without
+        poking the internals. ``total`` is re-derived from the rounded
+        ``mean_ms`` — percentiles are exact (counts are), the merged
+        mean carries the snapshot's 3-decimal rounding."""
+        h = cls(bounds)
+        h.counts = list(snap["counts"])
+        h.count = snap["count"]
+        h.total = snap["mean_ms"] * snap["count"]
+        h.max = snap["max_ms"]
+        return h
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Fold ``other`` into this histogram (same ladder required) —
+        how per-variant latency blocks aggregate into the per-priority
+        summaries a multi-model drill reports."""
+        if other.bounds != self.bounds:
+            raise ValueError("cannot merge histograms with different "
+                             "bucket ladders")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.total += other.total
+        if other.max > self.max:
+            self.max = other.max
+        return self
+
     def quantile(self, q: float) -> float:
         if not self.count:
             return 0.0
@@ -86,14 +117,29 @@ class ServingMetrics:
     ``path``: optional ``metrics.jsonl`` destination for
     :meth:`write_snapshot` (appended, Logger-style). Counter semantics:
     ``shed`` is work REJECTED at submit (queue full — backpressure),
-    ``deadline_missed`` is work that expired while still queued,
-    ``abandoned_inflight`` counts dispatched requests the scheduler
-    gave up on — by design never incremented; the acceptance drill
-    pins it at zero.
+    ``evicted`` is the subset of shed that was already QUEUED and gave
+    its slot to a higher-priority arrival (shed-batch-first; those
+    futures fail, so they also count ``failed`` — the accounting
+    identity stays exact), ``deadline_missed`` is work that expired
+    while still queued, ``abandoned_inflight`` counts dispatched
+    requests the scheduler gave up on — by design never incremented;
+    the acceptance drill pins it at zero.
+
+    ``namespace``: the model name this metrics block belongs to in a
+    multi-model registry — stamped as ``"model"`` on every snapshot
+    and event record so one metrics.jsonl serves N models and a
+    dashboard can group by it. None (default) keeps the single-model
+    record schema byte-identical.
+
+    Per-priority blocks appear lazily: the first ``priority=`` seen
+    creates that class's counters + latency histogram; priority-less
+    traffic records nothing there (zero overhead, unchanged schema).
     """
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None,
+                 namespace: Optional[str] = None):
         self.path = path
+        self.namespace = namespace
         self._lock = threading.Lock()
         self._buckets: Dict[str, Dict] = {}
         self._latency = LatencyHistogram()       # all-bucket total
@@ -101,8 +147,10 @@ class ServingMetrics:
         self.completed = 0
         self.failed = 0
         self.shed = 0
+        self.evicted = 0
         self.deadline_missed = 0
         self.cancelled = 0
+        self._priority: Dict[str, Dict] = {}
         self.abandoned_inflight = 0
         self.dispatches = 0
         self.depth_last = 0
@@ -146,18 +194,54 @@ class ServingMetrics:
         self._depth_sum += depth
         self._depth_samples += 1
 
-    def record_submit(self, depth: int) -> None:
+    def _prio(self, priority: Optional[str]) -> Optional[Dict]:
+        """The class's counter block, created on first use (caller
+        holds the lock). None priority records nothing per-class."""
+        if priority is None:
+            return None
+        p = self._priority.get(priority)
+        if p is None:
+            p = {"submitted": 0, "completed": 0, "shed": 0,
+                 "deadline_missed": 0, "latency": LatencyHistogram()}
+            self._priority[priority] = p
+        return p
+
+    def record_submit(self, depth: int,
+                      priority: Optional[str] = None) -> None:
         with self._lock:
             self.submitted += 1
             self._depth(depth)
+            p = self._prio(priority)
+            if p is not None:
+                p["submitted"] += 1
 
-    def record_shed(self) -> None:
+    def record_shed(self, priority: Optional[str] = None) -> None:
         with self._lock:
             self.shed += 1
+            p = self._prio(priority)
+            if p is not None:
+                p["shed"] += 1
 
-    def record_deadline_miss(self, n: int = 1) -> None:
+    def record_evicted(self, priority: Optional[str] = None) -> None:
+        """A queued request gave its slot to a higher-priority arrival
+        (shed-batch-first backpressure). Its future fails, so it counts
+        both shed AND failed — submitted == completed + failed +
+        deadline_missed + cancelled stays an identity."""
+        with self._lock:
+            self.shed += 1
+            self.evicted += 1
+            self.failed += 1
+            p = self._prio(priority)
+            if p is not None:
+                p["shed"] += 1
+
+    def record_deadline_miss(self, n: int = 1,
+                             priority: Optional[str] = None) -> None:
         with self._lock:
             self.deadline_missed += n
+            p = self._prio(priority)
+            if p is not None:
+                p["deadline_missed"] += n
 
     def record_cancelled(self, n: int = 1) -> None:
         with self._lock:
@@ -178,7 +262,8 @@ class ServingMetrics:
             self._depth(depth)
 
     def record_complete(self, bucket: str, queue_ms: float,
-                        device_ms: float) -> None:
+                        device_ms: float,
+                        priority: Optional[str] = None) -> None:
         with self._lock:
             self.completed += 1
             b = self._bucket(bucket)
@@ -186,6 +271,10 @@ class ServingMetrics:
             b["device"].observe(device_ms)
             b["total"].observe(queue_ms + device_ms)
             self._latency.observe(queue_ms + device_ms)
+            p = self._prio(priority)
+            if p is not None:
+                p["completed"] += 1
+                p["latency"].observe(queue_ms + device_ms)
 
     def record_failure(self, n: int = 1) -> None:
         with self._lock:
@@ -224,6 +313,8 @@ class ServingMetrics:
             return
         rec = {"event": event, "time": time.time(),
                "kind": "serving_event", **fields}
+        if self.namespace is not None and "model" not in rec:
+            rec["model"] = self.namespace
         try:
             parent = os.path.dirname(self.path)
             if parent:
@@ -292,6 +383,7 @@ class ServingMetrics:
                 "completed": self.completed,
                 "failed": self.failed,
                 "shed": self.shed,
+                "evicted": self.evicted,
                 "deadline_missed": self.deadline_missed,
                 "cancelled": self.cancelled,
                 "abandoned_inflight": self.abandoned_inflight,
@@ -336,6 +428,14 @@ class ServingMetrics:
                     },
                 },
                 "latency": self._latency.snapshot(),
+                "priority": {
+                    cls: {"submitted": p["submitted"],
+                          "completed": p["completed"],
+                          "shed": p["shed"],
+                          "deadline_missed": p["deadline_missed"],
+                          "latency": p["latency"].snapshot()}
+                    for cls, p in sorted(self._priority.items())
+                },
                 "hist_bounds_ms": list(_BOUNDS_MS),
                 "buckets": {
                     key: {
@@ -350,6 +450,8 @@ class ServingMetrics:
                     for key, b in sorted(self._buckets.items())
                 },
             }
+            if self.namespace is not None:
+                rec["model"] = self.namespace
         return rec
 
     def write_snapshot(self, executables: Optional[int] = None,

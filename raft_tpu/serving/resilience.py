@@ -74,6 +74,11 @@ class CircuitBreaker:
     the breaker lock (listeners append metrics events and recompute
     scheduler health — they must be free to read other breakers).
 
+    ``label`` names the breaker on the health surface. The scheduler
+    keys it ``model/HxW`` when it serves under a registry namespace
+    and plain ``HxW`` single-model — per model+bucket, so one model's
+    poisoned shape reads unambiguously on a board N models share.
+
     Probe discipline: this class does not ration probes itself — the
     scheduler's single dispatcher thread serializes dispatch, so at
     most one half-open probe is in flight by construction.
@@ -84,10 +89,11 @@ class CircuitBreaker:
                  rng: Optional[random.Random] = None,
                  clock: Callable[[], float] = time.monotonic,
                  on_transition: Optional[Callable[[str, str], None]]
-                 = None):
+                 = None, label: Optional[str] = None):
         if failures < 1:
             raise ValueError(f"failures={failures}: must be >= 1")
         self.failures = int(failures)
+        self.label = label
         self._clock = clock
         self._on_transition = on_transition
         self._mk_delays = lambda: backoff_delays(base_s, max_s,
@@ -173,12 +179,15 @@ class CircuitBreaker:
                 retry_in = max(0.0, self._retry_at - self._clock())
                 if retry_in == 0.0:
                     state = BREAKER_HALF_OPEN  # peek semantics
-            return {"state": state,
+            snap = {"state": state,
                     "consecutive_failures": self.consecutive,
                     "opens": self.opens,
                     "wedges": self.wedges,
                     "retry_in_s": (round(retry_in, 3)
                                    if retry_in is not None else None)}
+            if self.label is not None:
+                snap["label"] = self.label
+            return snap
 
 
 class _DispatchJob:

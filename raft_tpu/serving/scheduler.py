@@ -73,6 +73,23 @@ the host pads to the device (4× fewer H2D bytes, on-device
 normalize). The ``hot_path`` metrics block (dispatch-gap histogram,
 assembly overlap ratio, H2D bytes) proves it.
 
+Priority classes (ISSUE 9; default OFF = bitwise the above):
+``submit(..., priority=...)`` tags a request ``interactive``
+(sessions, one-shot demos) or ``batch`` (bulk offline traffic).
+Priority changes exactly two decisions and only when both classes are
+actually queued: **shed-batch-first backpressure** — an interactive
+arrival at a full queue evicts the newest queued batch-class request
+(its future fails ``BackpressureError``, counted shed AND failed so
+the accounting identity holds) instead of being rejected itself — and
+**weighted dequeue** — the dispatcher picks the interactive head
+``interactive_weight`` times for every batch head, so a batch flood
+cannot starve interactive p99 while batch still drains at a bounded
+fraction (no starvation either way). Priority-less traffic is one
+class: FIFO head, reject-new backpressure — the historical semantics,
+bit for bit. ``namespace`` prefixes breaker labels (``model/HxW``)
+and stamps metrics records when the scheduler serves one model of a
+:class:`~raft_tpu.serving.registry.ModelRegistry`.
+
 Observability rides along in :class:`~raft_tpu.serving.metrics.
 ServingMetrics`: per-bucket latency histograms for each stage
 (enqueue->dispatch->complete), batch occupancy, queue depth, shed and
@@ -101,8 +118,19 @@ from raft_tpu.serving.resilience import (BREAKER_CLOSED, BREAKER_OPEN,
 from raft_tpu.testing.faults import fault_point
 
 
+#: priority classes: ``interactive`` holds its p99 under load (evicts
+#: queued batch work at a full queue, wins the weighted dequeue);
+#: ``batch`` is the bulk tier that sheds first. None = the single
+#: historical class.
+PRIORITY_INTERACTIVE = "interactive"
+PRIORITY_BATCH = "batch"
+_PRIORITIES = (None, PRIORITY_INTERACTIVE, PRIORITY_BATCH)
+
+
 class BackpressureError(RuntimeError):
-    """Queue at max_queue: shed — the submitter should back off/retry."""
+    """Queue at max_queue: shed — the submitter should back off/retry.
+    Also fails a QUEUED batch-class future whose slot was taken by an
+    interactive arrival (shed-batch-first)."""
 
 
 class DeadlineExceeded(RuntimeError):
@@ -123,10 +151,11 @@ class ServeResult(NamedTuple):
 
 class _Request:
     __slots__ = ("image1", "image2", "key", "flow_init", "want_low",
-                 "low_device", "future", "t_submit", "deadline")
+                 "low_device", "future", "t_submit", "deadline",
+                 "priority")
 
     def __init__(self, image1, image2, key, flow_init, want_low,
-                 low_device, deadline):
+                 low_device, deadline, priority=None):
         self.image1 = image1
         self.image2 = image2
         self.key = key                  # (H, W) — the coalescing group
@@ -136,6 +165,7 @@ class _Request:
         self.future: Future = Future()
         self.t_submit = time.monotonic()
         self.deadline = deadline        # absolute monotonic, or None
+        self.priority = priority        # interactive | batch | None
 
 
 class MicroBatchScheduler:
@@ -175,6 +205,13 @@ class MicroBatchScheduler:
     consequences first (bucket dropped, breaker opened, completion
     worker quarantined + replaced, trailing completions re-queued on
     the replacement), THEN the batch's futures fail ``DispatchWedged``.
+
+    ``interactive_weight`` (only observable when BOTH priority classes
+    are queued): interactive dequeue picks per batch pick.
+    ``namespace``: the model name this scheduler serves under a
+    :class:`~raft_tpu.serving.registry.ModelRegistry` — prefixes
+    breaker labels and stamps metrics records; None (default) keeps
+    single-model labels/records byte-identical.
     """
 
     def __init__(self, engine, *, max_queue: int = 64, max_batch: int = 8,
@@ -185,6 +222,8 @@ class MicroBatchScheduler:
                  breaker_backoff_max_s: float = 30.0,
                  breaker_rng: Optional[random.Random] = None,
                  pipeline_depth: int = 1,
+                 interactive_weight: int = 4,
+                 namespace: Optional[str] = None,
                  metrics: Optional[ServingMetrics] = None,
                  metrics_path: Optional[str] = None):
         self.engine = engine
@@ -193,7 +232,20 @@ class MicroBatchScheduler:
         self.gather_window_s = float(gather_window_s)
         self.dispatch_timeout_s = (float(dispatch_timeout_s)
                                    if dispatch_timeout_s else None)
-        self.metrics = metrics or ServingMetrics(metrics_path)
+        #: interactive heads dequeued per batch head when BOTH classes
+        #: are queued (priority-less or single-class queues stay FIFO);
+        #: >= 1 so batch is rationed, never starved
+        self.interactive_weight = max(1, int(interactive_weight))
+        self._rr = 0           # weighted-round-robin dispatch counter
+        #: lifetime class flags (set under _cv at submit): until BOTH
+        #: have been seen, class mixing is impossible and the
+        #: dispatcher's head choice stays the O(1) FIFO peek — the
+        #: priority-less hot path never pays a queue scan
+        self._seen_batch = False
+        self._seen_interactive = False
+        self.namespace = namespace
+        self.metrics = metrics or ServingMetrics(metrics_path,
+                                                 namespace=namespace)
         self._cv = threading.Condition()
         self._q: Deque[_Request] = collections.deque()
         self._capacity: Dict[Tuple[int, int], int] = {}
@@ -241,18 +293,29 @@ class MicroBatchScheduler:
 
     def submit(self, image1, image2, *, deadline_s: Optional[float] = None,
                flow_init: Optional[np.ndarray] = None,
-               want_low: bool = False, low_device: bool = False) -> Future:
+               want_low: bool = False, low_device: bool = False,
+               priority: Optional[str] = None) -> Future:
         """Enqueue ONE ``(H, W, 3)`` frame pair; returns a Future
         resolving to :class:`ServeResult`. Raises
         :class:`BackpressureError` when the queue is full,
         :class:`CircuitOpen` when the shape's breaker is open, and
         :class:`SchedulerClosed` after ``close()``.
 
+        ``priority``: ``"interactive"`` | ``"batch"`` | None (the
+        single historical class). At a full queue an interactive
+        arrival takes the newest queued batch request's slot (that
+        future fails ``BackpressureError``); a batch or priority-less
+        arrival is rejected as before.
+
         ``flow_init`` may be a host array (validated here, embedded on
         the host) or a device array the engine itself produced
         (``low_device=True`` results) — the device path never round-
         trips through host memory. ``low_device=True`` makes the
         result's ``flow_low`` a device array too."""
+        if priority not in _PRIORITIES:
+            raise ValueError(
+                f"priority={priority!r}: choose "
+                f"{PRIORITY_INTERACTIVE!r}, {PRIORITY_BATCH!r} or None")
         image1 = np.asarray(image1)
         image2 = np.asarray(image2)
         # frames ride the engine's wire dtype from intake on: with a
@@ -326,7 +389,7 @@ class MicroBatchScheduler:
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
         req = _Request(image1, image2, key, flow_init, want_low,
-                       low_device, deadline)
+                       low_device, deadline, priority)
         with self._cv:
             if self._closed:
                 raise SchedulerClosed("scheduler is closed")
@@ -336,12 +399,40 @@ class MicroBatchScheduler:
             # while a dispatch is in flight
             self._sweep_locked(time.monotonic())
             if len(self._q) >= self.max_queue:
-                self.metrics.record_shed()
-                raise BackpressureError(
-                    f"queue full ({self.max_queue} pending) — shedding "
-                    "new work; retry with backoff")
+                victim = None
+                if priority == PRIORITY_INTERACTIVE:
+                    # shed-batch-first: the NEWEST queued batch-class
+                    # entry gives up its slot (it has waited least —
+                    # the oldest is closest to dispatch and evicting it
+                    # would waste the most queue time). Interactive and
+                    # priority-less entries are never evicted.
+                    for r in reversed(self._q):
+                        if (r.priority == PRIORITY_BATCH
+                                and not r.future.done()):
+                            victim = r
+                            break
+                if victim is None:
+                    self.metrics.record_shed(priority)
+                    raise BackpressureError(
+                        f"queue full ({self.max_queue} pending) — "
+                        "shedding new work; retry with backoff")
+                self._q.remove(victim)
+                try:
+                    victim.future.set_exception(BackpressureError(
+                        "shed by an interactive arrival under "
+                        "full-queue backpressure (batch class sheds "
+                        "first); retry with backoff"))
+                    self.metrics.record_evicted(victim.priority)
+                except InvalidStateError:
+                    # the victim's caller cancelled in the race window
+                    self.metrics.record_cancelled()
             self._q.append(req)
-            self.metrics.record_submit(depth=len(self._q))
+            if priority == PRIORITY_BATCH:
+                self._seen_batch = True
+            else:
+                self._seen_interactive = True
+            self.metrics.record_submit(depth=len(self._q),
+                                       priority=priority)
             self._cv.notify()
         return req.future
 
@@ -352,6 +443,13 @@ class MicroBatchScheduler:
 
     # -- breakers / health -------------------------------------------------
 
+    def _label(self, key: Tuple[int, int]) -> str:
+        """Breaker/event label for a request shape: ``model/HxW``
+        under a registry namespace, plain ``HxW`` single-model — the
+        per-model+bucket key the shared metrics.jsonl needs."""
+        base = f"{key[0]}x{key[1]}"
+        return f"{self.namespace}/{base}" if self.namespace else base
+
     def _breaker(self, key: Tuple[int, int]) -> Optional[CircuitBreaker]:
         """The shape's breaker, created on first dispatch (so health
         lists every active bucket). None when breakers are disarmed."""
@@ -361,12 +459,13 @@ class MicroBatchScheduler:
             br = self._breakers.get(key)
             if br is not None:
                 return br
-        label = f"{key[0]}x{key[1]}"
+        label = self._label(key)
         br = CircuitBreaker(
             failures=self._breaker_failures,
             base_s=self._breaker_backoff_s,
             max_s=self._breaker_backoff_max_s,
             rng=self._breaker_rng,
+            label=label,
             on_transition=lambda old, new, label=label:
                 self._on_breaker(label, old, new))
         with self._cv:
@@ -478,7 +577,7 @@ class MicroBatchScheduler:
                 # let the race kill a submitter or the dispatcher
                 self.metrics.record_cancelled()
                 return True
-            self.metrics.record_deadline_miss()
+            self.metrics.record_deadline_miss(priority=req.priority)
             return True
         return False
 
@@ -523,25 +622,39 @@ class MicroBatchScheduler:
                 return
             time.sleep(min(0.0005, self.gather_window_s))
 
-    def _take(self, key: Tuple[int, int], capacity: int
-              ) -> List[_Request]:
+    def _take(self, key: Tuple[int, int], capacity: int,
+              prefer: Optional[str] = None) -> List[_Request]:
         """Pop up to ``capacity`` same-shape requests FIFO, expiring
         stale deadlines (and reaping caller-cancelled futures) across
-        the whole queue on the way."""
+        the whole queue on the way. ``prefer`` (a priority class)
+        takes that class's entries first, then fills FIFO — without
+        it, a same-shape batch flood queued AHEAD of the interactive
+        head would defeat the weighted dequeue pick (``_take`` is
+        shape-keyed, and FIFO would hand the flood the whole
+        micro-batch). ``prefer=None`` is byte-identical to the
+        historical FIFO."""
         now = time.monotonic()
-        taken: List[_Request] = []
-        keep: Deque[_Request] = collections.deque()
         with self._cv:
+            live: List[_Request] = []
             for r in self._q:
                 if r.future.cancelled():
                     self.metrics.record_cancelled()
                 elif self._expire(r, now):
                     pass
-                elif r.key == key and len(taken) < capacity:
-                    taken.append(r)
                 else:
-                    keep.append(r)
-            self._q = keep
+                    live.append(r)
+            same = [r for r in live if r.key == key]
+            if prefer is not None:
+                want_batch = prefer == PRIORITY_BATCH
+                same = ([r for r in same
+                         if (r.priority == PRIORITY_BATCH) == want_batch]
+                        + [r for r in same
+                           if (r.priority == PRIORITY_BATCH)
+                           != want_batch])
+            taken = same[:capacity]
+            ids = set(map(id, taken))
+            self._q = collections.deque(r for r in live
+                                        if id(r) not in ids)
         return taken
 
     def _fail_requests(self, requests: List[_Request], exc: Exception
@@ -578,6 +691,41 @@ class MicroBatchScheduler:
             self._check_completions()
             time.sleep(0.001)
 
+    def _select_locked(self) -> Tuple[Tuple[int, int], Optional[str]]:
+        """Dispatch-head choice (caller holds ``_cv``, queue
+        nonempty): ``(key, preferred class)``. One queued class —
+        including the priority-less default — dispatches pure FIFO
+        with no preference (bitwise the historical path). With BOTH
+        interactive and batch work queued, weighted round-robin: the
+        interactive head wins ``interactive_weight`` picks per batch
+        pick, so a batch flood cannot starve interactive p99 while
+        batch still drains at a bounded fraction (never starved
+        either). Priority-less requests ride the interactive class —
+        default traffic must not queue behind a bulk flood. The
+        winning class is also the ``_take`` preference: its requests
+        fill the micro-batch first, the other class's same-shape work
+        may ride along in spare rows."""
+        if not (self._seen_batch and self._seen_interactive):
+            # only one class has EVER been submitted: mixing is
+            # impossible, skip the scan — the priority-less hot path
+            # stays the O(1) peek it always was
+            return self._q[0].key, None
+        first_int = first_bat = None
+        for r in self._q:
+            if r.priority == PRIORITY_BATCH:
+                if first_bat is None:
+                    first_bat = r
+            elif first_int is None:
+                first_int = r
+            if first_int is not None and first_bat is not None:
+                break
+        if first_int is None or first_bat is None:
+            return self._q[0].key, None
+        self._rr += 1
+        if self._rr % (self.interactive_weight + 1) == 0:
+            return first_bat.key, PRIORITY_BATCH
+        return first_int.key, PRIORITY_INTERACTIVE
+
     def _run(self) -> None:
         while True:
             with self._cv:
@@ -585,7 +733,8 @@ class MicroBatchScheduler:
                     self._cv.wait(timeout=0.05)
                     if self._completion is not None:
                         break   # idle tick: run the completion watchdog
-                key = self._q[0].key if self._q else None
+                key, prefer = (self._select_locked() if self._q
+                               else (None, None))
                 closed = self._closed
             if self._completion is not None:
                 self._check_completions()
@@ -606,18 +755,20 @@ class MicroBatchScheduler:
                 continue
             if self._exec is None:
                 job = _DispatchJob(None)
-                self._serve_key(key, job)
+                self._serve_key(key, job, prefer)
                 self._after_dispatch(key, job)
             else:
-                self._supervise(key)
+                self._supervise(key, prefer)
 
-    def _supervise(self, key: Tuple[int, int]) -> None:
+    def _supervise(self, key: Tuple[int, int],
+                   prefer: Optional[str] = None) -> None:
         """Run one supervised dispatch for ``key`` on the executor,
         scanning queued deadlines while it is in flight; wedge verdict
         past ``dispatch_timeout_s``."""
         timeout = self.dispatch_timeout_s
         job = self._exec.submit(
-            lambda j, key=key: self._serve_key(key, j))
+            lambda j, key=key, prefer=prefer:
+            self._serve_key(key, j, prefer))
         self._inflight_since = time.monotonic()
         try:
             poll = min(0.02, timeout / 4)
@@ -651,7 +802,7 @@ class MicroBatchScheduler:
         #                       dropped bucket
         self._inflight_since = None  # supervision is over: health is
         #                              degraded now, not wedged
-        label = f"{key[0]}x{key[1]}"
+        label = self._label(key)
         # verdict consequences land BEFORE the futures fail, so a
         # caller woken by its DispatchWedged observes consistent state
         # (executable dropped, breaker open, health degraded)
@@ -704,7 +855,7 @@ class MicroBatchScheduler:
         key = job.key
         job.abandoned = True   # a late-waking fetch must not settle
         #                        results or record a breaker success
-        label = f"{key[0]}x{key[1]}"
+        label = self._label(key)
         if job.bucket is not None:
             self.engine.drop_bucket(job.bucket)
         self._capacity.pop(key, None)
@@ -761,10 +912,12 @@ class MicroBatchScheduler:
         # the breaker outcome (success must mean RESULTS, not enqueue)
         self._refresh_state("dispatch outcome")
 
-    def _serve_key(self, key: Tuple[int, int], job: _DispatchJob) -> None:
+    def _serve_key(self, key: Tuple[int, int], job: _DispatchJob,
+                   prefer: Optional[str] = None) -> None:
         """One micro-batch for ``key``: capacity (may compile) ->
-        gather -> take -> dispatch. Runs inline on the dispatcher
-        thread (no watchdog) or on the supervised executor."""
+        gather -> take (``prefer``'s class first) -> dispatch. Runs
+        inline on the dispatcher thread (no watchdog) or on the
+        supervised executor."""
         try:
             # capacity may compile a bucket — never under the queue
             # lock (submitters would shed through the whole compile)
@@ -786,7 +939,7 @@ class MicroBatchScheduler:
             # means the replacement's probe finds the bucket ready)
             return
         self._gather(key, capacity)
-        batch = self._take(key, capacity)
+        batch = self._take(key, capacity, prefer)
         job.batch = batch
         if job.abandoned:
             # verdict landed between the check above and the take: the
@@ -848,7 +1001,8 @@ class MicroBatchScheduler:
                 continue  # wedge verdict settled it first
             self.metrics.record_complete(
                 label, queue_ms=(t_disp - r.t_submit) * 1e3,
-                device_ms=(t_done - t_disp) * 1e3)
+                device_ms=(t_done - t_disp) * 1e3,
+                priority=r.priority)
 
     def _complete_batch(self, key: Tuple[int, int], label: str,
                         live: List[_Request], pending, t_disp: float,

@@ -21,9 +21,16 @@ start, so warm and cold rows share one bucket executable.
 
 from __future__ import annotations
 
+import itertools
 from typing import Optional
 
 import numpy as np
+
+#: sticky route tokens for sessions over a ModelRegistry: one token per
+#: session, fixed for its lifetime, so the deterministic canary hash
+#: routes the WHOLE stream to one variant — a warm-start flow_init must
+#: never cross engines mid-stream
+_SESSION_IDS = itertools.count(1)
 
 
 class VideoSession:
@@ -37,7 +44,10 @@ class VideoSession:
 
     def __init__(self, scheduler, *, warm_start: bool = True,
                  device_state: bool = False,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 model: Optional[str] = None,
+                 priority: Optional[str] = None,
+                 route_key: Optional[str] = None):
         """``device_state=True`` keeps the recurrence state
         (``flow_low``) ON DEVICE between pairs: the scheduler returns a
         device array, the forward warp runs as a jitted scatter
@@ -48,11 +58,41 @@ class VideoSession:
         Shape-change and cold-restart paths still materialize to host
         (they reset the state to None and restart the recurrence);
         ``drain()`` always returns a host array. Default OFF: the host
-        scipy path is bitwise what it always was."""
+        scipy path is bitwise what it always was.
+
+        ``scheduler`` may also be a
+        :class:`~raft_tpu.serving.registry.ModelRegistry`: ``model``
+        then names the variant family to serve from, ``priority``
+        defaults to ``"interactive"`` (a session is a user waiting on
+        frames), and the session pins a sticky ``route_key`` so the
+        deterministic canary hash keeps the WHOLE stream on one
+        engine — warm-start state never crosses model variants
+        mid-stream. Against a plain scheduler all three stay unset and
+        the submit call is byte-identical to before."""
         self._sched = scheduler
         self.warm_start = bool(warm_start)
         self.device_state = bool(device_state)
         self.deadline_s = deadline_s
+        self._variant_version: Optional[str] = None
+        self._submit_kw = {}
+        if getattr(scheduler, "is_registry", False):
+            from raft_tpu.serving.scheduler import PRIORITY_INTERACTIVE
+
+            self._submit_kw["route_key"] = (
+                route_key if route_key is not None
+                else f"session-{next(_SESSION_IDS)}")
+            self._submit_kw["priority"] = (
+                priority if priority is not None else PRIORITY_INTERACTIVE)
+            if model is not None:
+                self._submit_kw["model"] = model
+        elif model is not None or route_key is not None:
+            # checked before the priority branch: a plain scheduler
+            # must reject these loudly whatever else is set — silently
+            # dropping model= would serve the wrong model's output
+            raise ValueError(
+                "model=/route_key= need a ModelRegistry scheduler")
+        elif priority is not None:
+            self._submit_kw["priority"] = priority
         self.frames = 0
         self.warm_submits = 0
         self._prev_frame: Optional[np.ndarray] = None
@@ -87,6 +127,21 @@ class VideoSession:
         if prev.shape != frame.shape:
             self._pending, self._flow_low = None, None
             return None
+        if "route_key" in self._submit_kw:
+            # registry rollout guard: if this stream's variant changed
+            # since the last pair (deploy/promote/rollback moved its
+            # hash assignment, or a promote shipped new weights), the
+            # recurrence cold-restarts — warm-start state produced by
+            # one variant must never feed another model's refinement.
+            # (A change landing between this read and the submit is a
+            # one-pair race; the NEXT pair cold-restarts.)
+            ver = self._sched.variant_version(
+                self._submit_kw.get("model"),
+                self._submit_kw["route_key"])
+            if ver != self._variant_version:
+                if self._variant_version is not None:
+                    self._pending, self._flow_low = None, None
+                self._variant_version = ver
         flow_init = None
         if self.warm_start:
             self._harvest()
@@ -122,7 +177,7 @@ class VideoSession:
             deadline_s=self.deadline_s if deadline_s is None
             else deadline_s,
             flow_init=flow_init, want_low=self.warm_start,
-            low_device=self.device_state)
+            low_device=self.device_state, **self._submit_kw)
         self._pending = fut
         return fut
 

@@ -34,7 +34,14 @@ Plan entries (a list of dicts, or ``{"faults": [...]}``):
     serving/engine.py — the blocking D2H read; a hang models a device
     whose compute or transfer never completes, which at
     ``pipeline_depth`` > 1 is the COMPLETION stage the scheduler's
-    watchdog must verdict across in-flight batches).
+    watchdog must verdict across in-flight batches),
+    ``registry.load`` (start of a model-variant build in
+    ``ModelRegistry`` — ``add_model`` and canary ``deploy``,
+    serving/registry.py; ``raise`` models a bad checkpoint artifact or
+    an uncompilable arch, and the registry's contract under it is
+    auto-rollback: the failed canary is discarded, ``DeployError``
+    surfaces to the deployer, and the live model's traffic never
+    touches the partial variant).
 ``at``
     1-based occurrence at which the entry becomes eligible (default 1).
     With the defaults below, each entry fires exactly once — the

@@ -6,8 +6,19 @@ half of the global batch through ``host_local_batch`` and runs one jitted
 train step. Asserts both processes compute the SAME loss, and that it
 matches a single-process run of the identical global batch on a 2-device
 mesh — the only previously-untested path in parallel/distributed.py.
+
+The whole module is gated on an environment probe: some hosts (and some
+jaxlib builds) wire the 2-process cluster up fine but cannot run the
+cross-process collectives the train step needs (observed: XLA
+"Multiprocess computations aren't implemented on the CPU backend").
+That is an environment verdict, not a code regression — the probe runs
+the minimal failing op (a 2-process ``sync_global_devices`` barrier)
+once per session and SKIPS the tests with the captured reason when the
+backend can't start, so tier-1 reads clean instead of carrying two
+known-environment failures every run.
 """
 
+import functools
 import os
 import re
 import socket
@@ -24,6 +35,58 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
+
+
+#: the minimal 2-process collective: initialize + a global barrier
+#: (sync_global_devices rides broadcast_one_to_all -> an all-reduce —
+#: the exact op class the real workers die on when the backend lacks
+#: multiprocess support). Tiny on purpose: no model, no train step.
+_PROBE_SRC = """
+import sys
+import jax
+jax.distributed.initialize(f"localhost:{sys.argv[2]}", num_processes=2,
+                           process_id=int(sys.argv[1]))
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("probe")
+print("PROBE_OK", flush=True)
+"""
+
+
+@functools.lru_cache(maxsize=1)
+def _multiprocess_backend_probe():
+    """(ok, reason): can this host actually run 2-process
+    ``jax.distributed`` collectives on the configured backend? Cached
+    for the session — one ~10s probe gates the whole module."""
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _PROBE_SRC, str(i), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    try:
+        outs = [p.communicate(timeout=180)[0] for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        return False, "probe barrier timed out (cluster never formed)"
+    if all(p.returncode == 0 and "PROBE_OK" in out
+           for p, out in zip(procs, outs)):
+        return True, ""
+    bad = next(out for p, out in zip(procs, outs)
+               if p.returncode != 0 or "PROBE_OK" not in out)
+    lines = [ln for ln in bad.strip().splitlines() if ln.strip()]
+    errs = [ln for ln in lines if "Error" in ln or "error:" in ln]
+    return False, (errs[-1] if errs else lines[-1] if lines
+                   else "no output").strip()
+
+
+@pytest.fixture(autouse=True)
+def _require_multiprocess_backend():
+    ok, reason = _multiprocess_backend_probe()
+    if not ok:
+        pytest.skip(
+            "2-process jax.distributed collectives unavailable on "
+            f"this host: {reason}")
 
 
 def _single_process_loss(n_devices: int = 2, spatial: int = 1) -> float:

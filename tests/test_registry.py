@@ -1,0 +1,542 @@
+"""Multi-model serving registry acceptance (ISSUE 9): versioned
+engines behind ``ModelRegistry`` — deterministic canary routing,
+promote/rollback with zero stranded futures, per-model accounting,
+auto-rollback on a failed deploy (the ``registry.load`` fault site) —
+plus the scheduler's priority classes: shed-batch-first backpressure
+and weighted dequeue under a batch flood."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_scheduler import _pad8
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import RAFT
+from raft_tpu.serving.engine import RAFTEngine
+from raft_tpu.serving.registry import (DeployError, ModelRegistry,
+                                       RolloutInProgress, UnknownModel,
+                                       canary_hash_fraction)
+from raft_tpu.serving.scheduler import (PRIORITY_BATCH,
+                                        PRIORITY_INTERACTIVE,
+                                        BackpressureError,
+                                        MicroBatchScheduler)
+from raft_tpu.serving.session import VideoSession
+from raft_tpu.testing import faults
+from tests.test_scheduler import _FakeEngine
+
+HW = (32, 32)
+BUCKET_BATCH = 4
+
+
+@pytest.fixture(scope="module")
+def basic_setup():
+    cfg = RAFTConfig()
+    model = RAFT(cfg)
+    img = jnp.zeros((1, *HW, 3))
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+    return cfg, variables
+
+
+@pytest.fixture(scope="module")
+def small_setup():
+    cfg = RAFTConfig(small=True)
+    model = RAFT(cfg)
+    img = jnp.zeros((1, *HW, 3))
+    variables = model.init(jax.random.PRNGKey(1), img, img, iters=1)
+    return cfg, variables
+
+
+@pytest.fixture(scope="module")
+def basic_engine(basic_setup):
+    """The accurate live tier: one warm-start bucket, shared across
+    the module (compiles once)."""
+    cfg, variables = basic_setup
+    return RAFTEngine(variables, cfg, iters=1,
+                      envelope=[(BUCKET_BATCH, *HW)], precompile=True,
+                      warm_start=True)
+
+
+@pytest.fixture(scope="module")
+def small_engine(small_setup):
+    """The fast canary tier (a DIFFERENT architecture than basic)."""
+    cfg, variables = small_setup
+    return RAFTEngine(variables, cfg, iters=1,
+                      envelope=[(BUCKET_BATCH, *HW)], precompile=True,
+                      warm_start=True)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm()
+
+
+def _pair(rng, h=HW[0], w=HW[1]):
+    return (rng.rand(h, w, 3).astype(np.float32) * 255,
+            rng.rand(h, w, 3).astype(np.float32) * 255)
+
+
+Z = np.zeros((*HW, 3), np.float32)
+
+
+class _WarmFakeEngine(_FakeEngine):
+    """_FakeEngine with the warm-start surface (flow_low output) so
+    session-recurrence drills run without XLA."""
+
+    warm_start = True
+
+    def infer_batch_async(self, i1, i2, flow_init=None,
+                          return_low=False, low_device=False):
+        inner = super().infer_batch_async(i1, i2)
+
+        class _P:
+            bucket = inner.bucket
+            h2d_bytes = inner.h2d_bytes
+            t_ready = None
+
+            def fetch(p):
+                flow = inner.fetch()
+                b, h, w = flow.shape[:3]
+                low = np.zeros((b, _pad8(h) // 8, _pad8(w) // 8, 2),
+                               np.float32)
+                p.t_ready = time.monotonic()
+                return flow, low
+
+        return _P()
+
+
+# -- deterministic routing hash -------------------------------------------
+
+
+class TestCanaryHash:
+    def test_deterministic_and_near_uniform(self):
+        vals = [canary_hash_fraction("m", i) for i in range(1000)]
+        assert vals == [canary_hash_fraction("m", i) for i in range(1000)]
+        assert all(0.0 <= v < 1.0 for v in vals)
+        # near-uniform: a 25% fraction lands within a few percent
+        frac = sum(v < 0.25 for v in vals) / len(vals)
+        assert abs(frac - 0.25) < 0.04
+        # the model name is part of the hash: two models split their
+        # token spaces independently
+        other = [canary_hash_fraction("other", i) for i in range(1000)]
+        assert other != vals
+
+    def test_sticky_token_pins_assignment(self):
+        reg = ModelRegistry(gather_window_s=0.0)
+        reg.add_model("m", {}, RAFTConfig(), engine=_FakeEngine())
+        reg.deploy("m", {}, engine=_FakeEngine(), canary_fraction=0.3)
+        want = reg.routes_to_canary("m", "user-42")
+        assert all(reg.routes_to_canary("m", "user-42") == want
+                   for _ in range(10))
+        reg.close()
+
+
+# -- registry lifecycle (duck-typed engines: fast, deterministic) ---------
+
+
+class TestRegistryLifecycle:
+    def test_unknown_model_and_single_model_default(self):
+        reg = ModelRegistry(gather_window_s=0.0)
+        reg.add_model("only", {}, RAFTConfig(), engine=_FakeEngine())
+        # single registered model: model= may be omitted
+        assert reg.submit(Z, Z).result(10).flow.shape == (*HW, 2)
+        with pytest.raises(UnknownModel):
+            reg.submit(Z, Z, model="nope")
+        reg.add_model("second", {}, RAFTConfig(small=True),
+                      engine=_FakeEngine())
+        with pytest.raises(UnknownModel):
+            reg.submit(Z, Z)   # ambiguous now
+        with pytest.raises(ValueError):
+            reg.add_model("only", {}, RAFTConfig(),
+                          engine=_FakeEngine())  # deploy(), not re-add
+        reg.close()
+
+    def test_one_rollout_at_a_time(self):
+        reg = ModelRegistry(gather_window_s=0.0)
+        reg.add_model("m", {}, RAFTConfig(), engine=_FakeEngine())
+        reg.deploy("m", {}, engine=_FakeEngine(), canary_fraction=0.5)
+        with pytest.raises(RolloutInProgress):
+            reg.deploy("m", {}, engine=_FakeEngine())
+        reg.rollback("m")
+        with pytest.raises(RolloutInProgress):
+            reg.rollback("m")    # nothing left to roll back
+        with pytest.raises(ValueError):
+            reg.deploy("m", {}, engine=_FakeEngine(),
+                       canary_fraction=1.5)
+        reg.close()
+
+    def test_deploy_failure_auto_rolls_back(self):
+        """The registry.load chaos site: a deploy that dies building
+        its variant surfaces DeployError, leaves NO canary, and live
+        traffic is untouched — then a clean deploy succeeds."""
+        reg = ModelRegistry(gather_window_s=0.0)
+        reg.add_model("m", {}, RAFTConfig(), engine=_FakeEngine())
+        faults.arm([{"site": "registry.load", "kind": "raise"}])
+        with pytest.raises(DeployError):
+            reg.deploy("m", {}, engine=_FakeEngine(),
+                       canary_fraction=0.5)
+        faults.disarm()
+        assert reg.health()["m"]["canary"] is None
+        assert reg.submit(Z, Z).result(10).flow.shape == (*HW, 2)
+        # the failed version number is burned, not reused
+        v = reg.deploy("m", {}, engine=_FakeEngine(),
+                       canary_fraction=0.5)
+        assert v == "v3"
+        reg.close()
+        snap = reg.snapshot()["m"]
+        assert snap["accounting_ok"]
+
+    def test_rollback_drains_canary_zero_stranded(self):
+        """rollback() stops routing first, then drains: every accepted
+        future settles; post-rollback traffic is 100% live."""
+        eng = _FakeEngine(infer_delay_s=0.02)
+        ceng = _FakeEngine(infer_delay_s=0.02)
+        reg = ModelRegistry(gather_window_s=0.0, max_batch=2)
+        reg.add_model("m", {}, RAFTConfig(), engine=eng)
+        reg.deploy("m", {}, engine=ceng, canary_fraction=1.0)
+        futs = [reg.submit(Z, Z, route_key=i) for i in range(12)]
+        reg.rollback("m")          # drain=True settles everything
+        assert all(f.done() for f in futs), "rollback stranded futures"
+        assert all(f.exception() is None for f in futs)
+        # canary retired: subsequent traffic serves from live
+        before = reg.snapshot()["m"]["live"]["submitted"]
+        reg.submit(Z, Z, route_key=3).result(10)
+        assert reg.snapshot()["m"]["live"]["submitted"] == before + 1
+        reg.close()
+        assert reg.snapshot()["m"]["accounting_ok"]
+
+    def test_session_sticks_to_one_variant(self):
+        """A VideoSession over the registry pins a sticky route token:
+        the whole stream lands on ONE variant (warm-start state must
+        never cross engines)."""
+        reg = ModelRegistry(gather_window_s=0.0)
+        reg.add_model("m", {}, RAFTConfig(), engine=_FakeEngine())
+        reg.deploy("m", {}, engine=_FakeEngine(), canary_fraction=0.5)
+        m = reg._models["m"]
+
+        def run_session(**kw):
+            live0 = m.live.scheduler.metrics.submitted
+            can0 = m.canary.scheduler.metrics.submitted
+            sess = VideoSession(reg, warm_start=False, **kw)
+            for _ in range(4):
+                f = sess.submit_frame(Z)
+                if f is not None:
+                    f.result(10)
+            return (m.live.scheduler.metrics.submitted - live0,
+                    m.canary.scheduler.metrics.submitted - can0)
+
+        # deterministic keys covering both sides of the 50% split
+        keys = [f"s{i}" for i in range(8)]
+        sides = {k: canary_hash_fraction("m", k) < 0.5 for k in keys}
+        assert len(set(sides.values())) == 2   # both variants drawn
+        for k in keys:
+            delta = run_session(route_key=k)
+            # the session's 3 pairs landed WHOLLY on its hash's variant
+            assert delta == ((0, 3) if sides[k] else (3, 0)), (k, delta)
+        # the auto-assigned sticky token path: still all-one-side
+        assert run_session() in ((3, 0), (0, 3))
+        reg.close()
+
+    def test_rollout_cold_restarts_session_recurrence(self):
+        """A rollback that moves a warm stream off its variant must
+        cold-restart the recurrence: one variant's flow_low never
+        feeds another model's refinement (the pair AFTER the rollout
+        submits cold, then warming resumes)."""
+        reg = ModelRegistry(gather_window_s=0.0)
+        reg.add_model("m", {}, RAFTConfig(), engine=_WarmFakeEngine())
+        reg.deploy("m", {}, engine=_WarmFakeEngine(),
+                   canary_fraction=1.0)   # every key routes canary
+        sess = VideoSession(reg)          # warm_start=True default
+        for _ in range(3):                # pairs 1 (cold) + 2 (warm)
+            f = sess.submit_frame(Z)
+            if f is not None:
+                f.result(10)
+        assert sess.warm_submits == 1
+        reg.rollback("m")                 # stream moves to live
+        f = sess.submit_frame(Z)          # pair 3: MUST cold-restart
+        f.result(10)
+        assert sess.warm_submits == 1, \
+            "stale canary flow_low warm-started the live model"
+        f = sess.submit_frame(Z)          # pair 4: warming resumes
+        f.result(10)
+        assert sess.warm_submits == 2
+        reg.close()
+
+
+# -- priority classes (scheduler layer) -----------------------------------
+
+
+class TestPriorityClasses:
+    def test_shed_batch_first_under_backpressure(self):
+        """Full queue + interactive arrival: the newest queued batch
+        entry is evicted (fails BackpressureError, counted shed AND
+        failed); interactive work is never evicted; identity holds."""
+        eng = _FakeEngine(infer_delay_s=0.05)
+        s = MicroBatchScheduler(eng, max_queue=4, max_batch=1,
+                                gather_window_s=0.0)
+        bat, rejected = [], 0
+        for _ in range(12):
+            try:
+                bat.append(s.submit(Z, Z, priority=PRIORITY_BATCH))
+            except BackpressureError:
+                rejected += 1
+        inter = [s.submit(Z, Z, priority=PRIORITY_INTERACTIVE)
+                 for _ in range(3)]
+        for f in inter:
+            assert f.result(30).flow.shape == (*HW, 2)
+        s.close()
+        evicted = sum(1 for f in bat if f.done()
+                      and isinstance(f.exception(), BackpressureError))
+        assert rejected > 0 and evicted > 0
+        snap = s.metrics.snapshot()
+        assert snap["evicted"] == evicted
+        p = snap["priority"]
+        assert p[PRIORITY_INTERACTIVE]["shed"] == 0
+        assert p[PRIORITY_INTERACTIVE]["completed"] == 3
+        assert p[PRIORITY_BATCH]["shed"] == rejected + evicted
+        assert snap["submitted"] == (snap["completed"] + snap["failed"]
+                                     + snap["deadline_missed"]
+                                     + snap["cancelled"])
+
+    def test_priority_less_path_never_evicts(self):
+        """Default traffic at a full queue sheds NEW work only — the
+        historical contract, bit for bit (no priorities, no eviction,
+        no priority block in the snapshot)."""
+        eng = _FakeEngine(infer_delay_s=0.05)
+        s = MicroBatchScheduler(eng, max_queue=2, max_batch=1,
+                                gather_window_s=0.0)
+        futs = []
+        with pytest.raises(BackpressureError):
+            for _ in range(10):
+                futs.append(s.submit(Z, Z))
+        s.close()
+        assert all(f.exception() is None for f in futs)
+        snap = s.metrics.snapshot()
+        assert snap["evicted"] == 0 and snap["priority"] == {}
+
+    def test_weighted_dequeue_pulls_interactive_ahead(self):
+        """A batch flood is queued first; interactive arrivals still
+        complete ahead of most of it (weighted round-robin head)."""
+        eng = _FakeEngine(infer_delay_s=0.03)
+        s = MicroBatchScheduler(eng, max_queue=64, max_batch=1,
+                                gather_window_s=0.0)
+        order = []
+        olock = threading.Lock()
+
+        def tag(name):
+            def cb(_):
+                with olock:
+                    order.append(name)
+            return cb
+
+        for i in range(10):
+            s.submit(Z, Z, priority=PRIORITY_BATCH).add_done_callback(
+                tag(f"b{i}"))
+        for i in range(4):
+            s.submit(Z, Z,
+                     priority=PRIORITY_INTERACTIVE).add_done_callback(
+                tag(f"i{i}"))
+        s.close()
+        assert len(order) == 14
+        pos = {name: k for k, name in enumerate(order)}
+        mean_i = sum(pos[f"i{i}"] for i in range(4)) / 4
+        mean_b = sum(pos[f"b{i}"] for i in range(10)) / 10
+        # interactive submitted LAST but completes ahead of the flood
+        assert mean_i < mean_b, (order, mean_i, mean_b)
+        # batch is rationed, not starved: the batch head completes
+        # within one full weighted cycle (interactive_weight picks +
+        # its own) of the start, whatever the submit/dispatch race
+        assert pos["b0"] <= 5, order
+
+    def test_invalid_priority_rejected(self):
+        s = MicroBatchScheduler(_FakeEngine(), gather_window_s=0.0)
+        with pytest.raises(ValueError):
+            s.submit(Z, Z, priority="realtime")
+        s.close()
+
+
+# -- the ISSUE-9 acceptance drill (real stack) ----------------------------
+
+
+class TestTwoModelAcceptanceDrill:
+    def test_canary_rollout_drill(self, basic_setup, small_setup,
+                                  basic_engine, small_engine):
+        """Deploy small as canary at 25% next to live basic; assert
+        the deterministic routing fraction (±5% over >= 400 requests),
+        bitwise-stable live outputs during the canary window, promote
+        (new arch: engine swap), then zero stranded futures and the
+        per-model accounting identity across the whole rollout."""
+        basic_cfg, basic_vars = basic_setup
+        small_cfg, small_vars = small_setup
+        rng = np.random.RandomState(7)
+        xa, xb = _pair(rng)   # ONE fixed pair: bitwise references
+        ref_live = basic_engine.infer_batch(xa[None], xb[None])[0]
+        ref_canary = small_engine.infer_batch(xa[None], xb[None])[0]
+        # the two archs must be tellable apart at fp noise scale, or
+        # the classification below is meaningless
+        gap = float(np.abs(ref_live - ref_canary).max())
+        assert gap > 1e-2, f"ref outputs too close to classify ({gap})"
+
+        reg = ModelRegistry(max_batch=BUCKET_BATCH,
+                            gather_window_s=0.002)
+        reg.add_model("basic", basic_vars, basic_cfg, iters=1,
+                      engine=basic_engine)
+        version = reg.deploy("basic", small_vars, small_cfg,
+                             canary_fraction=0.25, engine=small_engine)
+        assert version == "v2"
+        predicted = [reg.routes_to_canary("basic", i)
+                     for i in range(400)]
+
+        # -- bitwise window: sequential singles (each dispatch fills
+        # the bucket identically), every live result must equal the
+        # pre-rollout reference BIT FOR BIT, canary results the
+        # canary's
+        for i in range(24):
+            flow = reg.submit(xa, xb, model="basic",
+                              route_key=i).result(timeout=600).flow
+            want = ref_canary if predicted[i] else ref_live
+            np.testing.assert_array_equal(
+                flow, want,
+                err_msg=f"request {i} (canary={predicted[i]}) not "
+                        "bitwise its engine's reference")
+
+        # -- routing fraction: >= 400 requests, concurrent submitters
+        # (with polite backpressure backoff — the queue is bounded)
+        futs = {}
+        flock = threading.Lock()
+
+        def submit_range(lo, hi):
+            for i in range(lo, hi):
+                while True:
+                    try:
+                        f = reg.submit(xa, xb, model="basic",
+                                       route_key=i)
+                        break
+                    except BackpressureError:
+                        time.sleep(0.01)
+                with flock:
+                    futs[i] = f
+
+        threads = [threading.Thread(target=submit_range,
+                                    args=(24, 212)),
+                   threading.Thread(target=submit_range,
+                                    args=(212, 400))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        served_canary = 0
+        for i, f in sorted(futs.items()):
+            flow = f.result(timeout=600).flow
+            d_live = float(np.abs(flow - ref_live).max())
+            d_can = float(np.abs(flow - ref_canary).max())
+            # coalesced fills move outputs only at conv-vectorization
+            # noise scale — nearest-reference classification is exact
+            is_canary = d_can < d_live
+            assert min(d_live, d_can) < gap / 4
+            assert is_canary == predicted[i], \
+                f"request {i} served by the wrong variant"
+            served_canary += is_canary
+        total_canary = served_canary + sum(predicted[:24])
+        frac = total_canary / 400
+        assert abs(frac - 0.25) <= 0.05, \
+            f"canary fraction {frac} off the deployed 0.25"
+
+        # -- promote: small is a NEW arch -> engine swap; post-promote
+        # traffic serves the promoted engine
+        out = reg.promote("basic")
+        assert out["mode"] == "engine_swap" and out["version"] == "v2"
+        for i in range(4):
+            flow = reg.submit(xa, xb,
+                              model="basic").result(timeout=600).flow
+            d_can = float(np.abs(flow - ref_canary).max())
+            assert d_can < gap / 4, "post-promote output not the " \
+                                    "promoted model's"
+        # zero stranded across the rollout
+        assert all(f.done() for f in futs.values())
+        reg.close()
+        snap = reg.snapshot()["basic"]
+        assert snap["accounting_ok"], snap["totals"]
+        # 24 bitwise-window + 376 fraction-window + 4 post-promote;
+        # the backpressure retries above mean every request was
+        # eventually ACCEPTED, so completed must equal submitted —
+        # zero dropped across deploy -> canary -> promote
+        assert snap["totals"]["submitted"] == 404
+        assert snap["totals"]["completed"] == 404
+        abandoned = sum(
+            s["abandoned_inflight"]
+            for s in [snap["live"]] + snap["retired"])
+        assert abandoned == 0
+        # engine hygiene: one bucket each, no cross-model leakage, no
+        # compile storm from the rollout
+        assert len(basic_engine._compiled) == 1
+        assert len(small_engine._compiled) == 1
+
+    def test_priority_drill_real_stack(self, small_setup, small_engine):
+        """Under full-queue backpressure on the real stack: batch
+        sheds first (rejections and evictions), every interactive
+        request completes."""
+        cfg, variables = small_setup
+        reg = ModelRegistry(max_batch=BUCKET_BATCH, max_queue=6,
+                            gather_window_s=0.05)
+        reg.add_model("small", variables, cfg, iters=1,
+                      engine=small_engine)
+        rng = np.random.RandomState(3)
+        xa, xb = _pair(rng)
+        bat, bat_rejected = [], 0
+        for _ in range(24):
+            try:
+                bat.append(reg.submit(xa, xb,
+                                      priority=PRIORITY_BATCH))
+            except BackpressureError:
+                bat_rejected += 1
+        inter = []
+        for _ in range(4):
+            inter.append(reg.submit(xa, xb,
+                                    priority=PRIORITY_INTERACTIVE))
+        for f in inter:
+            assert f.result(timeout=600).flow.shape == (*HW, 2), \
+                "interactive request failed under batch flood"
+        reg.close()
+        snap = reg.snapshot()["small"]
+        p = snap["live"]["priority"]
+        assert bat_rejected > 0, "flood never hit backpressure"
+        assert snap["live"]["evicted"] > 0, \
+            "no queued batch work was evicted for interactive arrivals"
+        assert p[PRIORITY_INTERACTIVE]["shed"] == 0
+        assert p[PRIORITY_INTERACTIVE]["completed"] == 4
+        assert p[PRIORITY_BATCH]["shed"] >= bat_rejected
+        assert snap["accounting_ok"], snap["totals"]
+
+    def test_registry_chaos_soak(self, small_setup):
+        """The registry chaos drill at tiny shapes: randomized fault
+        rounds (drawing registry.load) + the clean round — zero
+        violations, some deploy attempts, per-model identity."""
+        from raft_tpu.cli.serve_bench import run_registry_chaos
+
+        cfg, variables = small_setup
+        canary_vars = RAFT(cfg).init(jax.random.PRNGKey(9),
+                                     jnp.zeros((1, *HW, 3)),
+                                     jnp.zeros((1, *HW, 3)), iters=1)
+        summary = run_registry_chaos(
+            [("tier_a", variables, cfg), ("tier_b", variables, cfg)],
+            shapes=[HW], rounds=2, requests=10, submitters=2,
+            bucket_batch=3, iters=1, priority_mix=(1, 1),
+            canary_fraction=0.5, canary_variables=canary_vars,
+            dispatch_timeout_s=0.5, hang_s=1.0, breaker_failures=2,
+            breaker_backoff_s=0.1, breaker_backoff_max_s=0.4,
+            seed=5)
+        assert summary["violations"] == []
+        assert summary["deploys"]["attempted"] == 3
+        # round 0's deploy is forced to fail at registry.load: the
+        # auto-rollback path ran and left no canary behind (a leak is
+        # a violation above)
+        assert summary["deploys"]["auto_rolled_back"] >= 1
+        # the clean round always deploys; at least it must land
+        assert summary["deploys"]["deployed"] >= 1

@@ -350,6 +350,80 @@ def _build_scheduler_coalesce():
     return build
 
 
+def _build_registry_two_models():
+    def build():
+        ensure_cpu()
+        import numpy as np
+        from raft_tpu.serving.registry import ModelRegistry
+
+        variables, cfg = _engine_weights()
+        h, w = _IMAGE_HW
+        rng = np.random.RandomState(0)
+
+        def pair():
+            return (rng.rand(h, w, 3).astype(np.float32) * 255,
+                    rng.rand(h, w, 3).astype(np.float32) * 255)
+
+        def counts(reg):
+            return {name: len(reg._models[name].live.engine._compiled)
+                    for name in reg.models()}
+
+        # two model families over one weight tree (the canary audits
+        # the REGISTRY's engine hygiene — per-model executable
+        # ownership — not the models): each gets its own engine with
+        # one documented bucket
+        with ModelRegistry(max_batch=2, gather_window_s=0.0) as reg:
+            reg.add_model("accurate", variables, cfg, iters=_ITERS,
+                          envelope=[(2, h, w)])
+            reg.add_model("fast", variables, cfg, iters=_ITERS,
+                          envelope=[(2, h, w)])
+            for name in ("accurate", "fast"):
+                for i in range(2):
+                    i1, i2 = pair()
+                    reg.submit(i1, i2, model=name).result(timeout=600)
+            assert counts(reg) == {"accurate": 1, "fast": 1}, \
+                f"pre-deploy executable leakage: {counts(reg)}"
+            # deploy -> canary -> promote on "accurate" (same arch):
+            # the canary compiles ITS one bucket; the other model's
+            # engine must not grow (no cross-model leakage)
+            reg.deploy("accurate", variables, canary_fraction=0.5)
+            canary_eng = reg._models["accurate"].canary.engine
+            for i in range(4):
+                i1, i2 = pair()
+                reg.submit(i1, i2, model="accurate",
+                           route_key=f"c{i}").result(timeout=600)
+            assert len(canary_eng._compiled) == 1, \
+                "canary engine leaked buckets"
+            assert counts(reg) == {"accurate": 1, "fast": 1}, \
+                f"canary deploy leaked into live engines: {counts(reg)}"
+            live_eng = reg._models["accurate"].live.engine
+            reg.promote("accurate")
+            # same-arch promote is a weight swap INTO the live engine:
+            # same engine object, same single executable — no compile
+            # storm, no swap to the canary's duplicate engine
+            assert reg._models["accurate"].live.engine is live_eng, \
+                "same-arch promote replaced the live engine"
+            for i in range(2):
+                i1, i2 = pair()
+                reg.submit(i1, i2, model="accurate").result(timeout=600)
+            assert counts(reg) == {"accurate": 1, "fast": 1}, \
+                f"post-promote compile storm: {counts(reg)}"
+            engines = {name: reg._models[name].live.engine
+                       for name in reg.models()}
+        texts = tuple(exe.as_text()
+                      for eng in engines.values()
+                      for exe in eng._compiled.values() if exe)
+        return CanaryResult(
+            observed_compiles=sum(len(eng._compiled)
+                                  for eng in engines.values()),
+            detail="two-model registry at "
+                   f"{h}x{w}: per-model engines pinned at 1 bucket "
+                   "each through a deploy -> canary -> promote cycle "
+                   "(same-arch promote reuses the live executable)",
+            hlo_texts=texts)
+    return build
+
+
 def build_targets() -> List[Target]:
     return [
         Target(
@@ -403,6 +477,19 @@ def build_targets() -> List[Target]:
             notes="u8 wire: uint8 executable params (no host-side "
                   "widening), bitwise parity vs f32 at integer "
                   "inputs, warm-start round-trip"),
+        Target(
+            name="registry_two_models",
+            kind="canary",
+            build=_build_registry_two_models(),
+            expect_compiles=2,     # one bucket per live model engine —
+            #                        pinned through deploy -> canary ->
+            #                        promote (the canary's own single
+            #                        bucket retires with it; same-arch
+            #                        promote swaps weights, not
+            #                        executables)
+            notes="multi-model registry: per-engine executable counts "
+                  "through a canary rollout — no cross-model leakage, "
+                  "no compile storm on promote"),
         Target(
             name="scheduler_coalesce",
             kind="canary",

@@ -210,6 +210,24 @@ step serve_chaos_r6 1800 python -m raft_tpu.cli.serve_bench \
     --breaker-backoff-ms 5000 --breaker-backoff-max-ms 600000 \
     --recover-s 300 --gather-ms 20 --log-dir /tmp/raft_serve_chaos_r6
 
+# ---- multi-model registry: basic+small mixed-priority drill (PR 9) ---
+# the two paper archs served side by side behind the ModelRegistry:
+# basic is the accurate live tier, small the fast tier, traffic split
+# 3:1 interactive:batch, plus a same-arch canary rollout on basic at
+# 25% that promotes after traffic (update_weights swap — watch the
+# summary's canary block report resolution=weights_swap and the
+# per-model executables_live stay at the documented bucket counts).
+# The per-model p50/p99 blocks are the REAL basic-vs-small latency
+# tiering numbers the fast-tier case (Rethinking RAFT) needs in
+# PROFILE.md; the CPU tier-1 drill only proves routing/accounting.
+# Deadline sized for on-chip compiles of BOTH models' buckets plus
+# the canary's (three envelopes compile in this window).
+step serve_registry_r6 2400 python -m raft_tpu.cli.serve_bench \
+    --models basic,small --shapes 440x1024,368x496 --requests 48 \
+    --submitters 2 --bucket-batch 4 --priority-mix 3:1 --canary 0.25 \
+    --deadline-ms 120000 --gather-ms 20 --iters 20 \
+    --log-dir /tmp/raft_serve_registry_r6
+
 # ---- trace the loser's question: where did the fused step's time go ---
 # (only worth a window slot once both A/B rungs have numbers)
 if [ -e "$MARK/bench_g_gruxla" ] && [ -e "$MARK/bench_g_grufused" ]; then
