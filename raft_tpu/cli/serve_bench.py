@@ -40,6 +40,20 @@ per-model blocks (latency, occupancy, shed, accounting identity PER
 MODEL) and per-priority blocks (latency, shed). With ``--chaos N``
 the rounds draw the ``registry.load`` site too: a failed canary
 deploy must auto-roll-back and never touch live-model traffic.
+
+``--guardian`` (with ``--models``) hands the rollout verdict to the
+SLO guardian (serving/guardian.py): the canary bakes against the live
+variant's window metrics under the ``--slo``/``--bake-ms`` policy and
+the guardian auto-promotes or auto-rolls-back — the summary grows a
+``guardian`` block (decisions with their evidence windows) and the
+canary block reports ``resolution=guardian_promote|guardian_rollback|
+guardian_undecided``. ``--admission-budget N`` arms the registry-wide
+token bucket (``--admission-reserve`` interactive-only tokens);
+per-model ``admission_rejected`` counts land in the model blocks.
+Under ``--chaos`` the plans additionally draw ``guardian.decide`` — a
+guardian that raises or hangs mid-decision must strand nothing and
+never leave a half-rolled canary, and the clean round must end in a
+guardian auto-promote.
 """
 
 from __future__ import annotations
@@ -67,6 +81,10 @@ CHAOS_SITES_PIPELINED = CHAOS_SITES + ("serve.fetch",)
 #: registry drills add the model-variant build path: a failed canary
 #: deploy must auto-roll-back without touching live traffic
 CHAOS_SITES_REGISTRY = CHAOS_SITES + ("registry.load",)
+#: guardian-attended drills add the decision point: a guardian that
+#: raises or hangs mid-decision must strand nothing and leave routing
+#: exactly as it found it (the site fires before any registry mutation)
+CHAOS_SITES_GUARDIAN = CHAOS_SITES_REGISTRY + ("guardian.decide",)
 
 
 def chaos_plan(rng: random.Random, hang_s: float = 0.5,
@@ -454,6 +472,9 @@ def run_registry_drill(models, *, shapes, requests=48, submitters=2,
                        breaker_failures=0, breaker_backoff_s=0.25,
                        breaker_backoff_max_s=30.0, wire="f32",
                        pipeline_depth=1, sessions=0, session_frames=4,
+                       admission_budget=None, admission_reserve=None,
+                       guardian=False, guardian_policy=None,
+                       guardian_poll_s=0.05, guardian_timeout_s=30.0,
                        fault_plan=None, metrics_path=None, seed=0,
                        engines=None, canary_engine=None):
     """Mixed-model, mixed-priority drill over a ``ModelRegistry``.
@@ -470,7 +491,18 @@ def run_registry_drill(models, *, shapes, requests=48, submitters=2,
     traffic untouched (asserted via the summary's ``canary`` block).
     ``engines``/``canary_engine`` inject prebuilt engines so chaos
     rounds share compiles. Returns the one-line summary dict with
-    per-model and per-priority blocks."""
+    per-model and per-priority blocks.
+
+    ``guardian=True`` hands the rollout verdict to an
+    :class:`~raft_tpu.serving.guardian.SLOGuardian` polling the
+    registry (``guardian_policy``: GuardianPolicy kwargs): the drill
+    waits up to ``guardian_timeout_s`` for its decision instead of
+    promoting/rolling back manually, records it in the summary's
+    ``canary``/``guardian`` blocks, and a guardian that never decides
+    (wedged at the ``guardian.decide`` chaos site) must leave the
+    canary fully routed — never half-rolled — for ``close()`` to
+    drain. ``admission_budget`` arms the registry-wide token bucket;
+    rejections land per model as ``admission_rejected``."""
     import numpy as np
 
     from raft_tpu.serving.registry import DeployError, ModelRegistry
@@ -492,16 +524,26 @@ def run_registry_drill(models, *, shapes, requests=48, submitters=2,
                         breaker_backoff_s=breaker_backoff_s,
                         breaker_backoff_max_s=breaker_backoff_max_s,
                         breaker_rng=random.Random(seed),
-                        pipeline_depth=pipeline_depth)
+                        pipeline_depth=pipeline_depth,
+                        admission_budget=admission_budget,
+                        admission_interactive_reserve=admission_reserve)
     for name, variables, cfg in models:
         reg.add_model(name, variables, cfg, iters=iters,
                       envelope=envelope,
                       engine=(engines or {}).get(name),
                       warm_start=True, wire=wire)
+    guard = None
+    if guardian:
+        from raft_tpu.serving.guardian import GuardianPolicy, SLOGuardian
+
+        guard = SLOGuardian(reg, GuardianPolicy(**(guardian_policy
+                                                   or {})),
+                            poll_s=guardian_poll_s).start()
     target = models[0][0]
     canary = {"requested": canary_fraction > 0, "deployed": False,
               "version": None, "deploy_failed": None,
-              "leaked_after_failure": False, "resolution": None}
+              "leaked_after_failure": False, "resolution": None,
+              "half_rolled": False}
     accepted = [[] for _ in range(submitters)]   # (future, model, prio)
     shed = [0] * submitters
     rejected = [0] * submitters
@@ -570,6 +612,13 @@ def run_registry_drill(models, *, shapes, requests=48, submitters=2,
                     canary_fraction=canary_fraction,
                     engine=canary_engine)
                 canary["deployed"] = True
+                if guard is not None:
+                    # open the bake BEFORE traffic so the judged
+                    # window contains the drill's requests — on a fast
+                    # drill the polling loop's first post-deploy tick
+                    # could otherwise freeze its baseline after the
+                    # traffic already completed
+                    guard.tick()
             except DeployError as exc:
                 canary["deploy_failed"] = str(exc)[:200]
                 canary["leaked_after_failure"] = (
@@ -581,14 +630,40 @@ def run_registry_drill(models, *, shapes, requests=48, submitters=2,
         futures_wait([f for fl in accepted for (f, _, _) in fl],
                      timeout=600)
         if canary["deployed"]:
-            if promote:
+            if guard is not None:
+                # the guardian owns the verdict: wait it out (bounded
+                # — a wedged guardian must not wedge the drill), and a
+                # canary it never resolved must still be FULLY routed
+                # (state canary, fraction > 0) for close() to drain —
+                # half-rolled is the invariant violation chaos hunts
+                decision = guard.wait_decision(
+                    target, timeout=guardian_timeout_s)
+                canary["resolution"] = (
+                    "guardian_" + decision["action"]
+                    if decision is not None else "guardian_undecided")
+            elif promote:
                 canary["resolution"] = reg.promote(target)["mode"]
             else:
                 reg.rollback(target)
                 canary["resolution"] = "rolled_back"
         health = reg.health()
+        tgt_canary = health[target]["canary"]
+        canary["half_rolled"] = (
+            tgt_canary is not None
+            and (tgt_canary["state"] != "canary"
+                 or not tgt_canary["fraction"] > 0))
+        guardian_block = None
+        if guard is not None:
+            guardian_block = {
+                "decisions": list(guard.decisions),
+                "errors": guard.errors,
+                "wedged": not guard.stop(timeout=5.0),
+            }
+        admission = reg.admission_snapshot()
         reg.close(drain=True)
     finally:
+        if guard is not None:
+            guard.stop(timeout=0.1)
         if fault_plan is not None:
             faults.disarm()
     wall = time.perf_counter() - t0
@@ -604,6 +679,7 @@ def run_registry_drill(models, *, shapes, requests=48, submitters=2,
             "failed": blk["totals"]["failed"],
             "shed": blk["totals"]["shed"],
             "evicted": blk["totals"]["evicted"],
+            "admission_rejected": blk["totals"]["admission_rejected"],
             "deadline_missed": blk["totals"]["deadline_missed"],
             "cancelled": blk["totals"]["cancelled"],
             "accounting_ok": blk["accounting_ok"],
@@ -662,6 +738,8 @@ def run_registry_drill(models, *, shapes, requests=48, submitters=2,
         "session_pairs": session_stats["pairs"],
         "warm_submits": session_stats["warm"],
         "canary": canary,
+        "guardian": guardian_block,
+        "admission": admission,
         "models": per_model,
         "priorities": _merged_priority_blocks(all_snaps),
         "wall_s": round(wall, 3),
@@ -687,6 +765,10 @@ def _registry_round_violations(s: dict) -> list:
             and s["canary"]["leaked_after_failure"]):
         v.append("failed canary deploy left a canary routing traffic "
                  "(auto-rollback broken)")
+    if s["canary"].get("half_rolled"):
+        v.append("canary left half-rolled (present but not fully "
+                 "routed) — a wedged guardian must leave routing "
+                 "exactly as it found it")
     return v
 
 
@@ -698,18 +780,27 @@ def run_registry_chaos(models, *, shapes, rounds=2, requests=16,
                        breaker_backoff_s=0.15,
                        breaker_backoff_max_s=0.6,
                        gather_window_s=0.0, max_queue=64,
-                       deadline_s=None, seed=0, metrics_path=None):
+                       deadline_s=None, guardian=True,
+                       guardian_policy=None, guardian_timeout_s=8.0,
+                       admission_budget=None, admission_reserve=None,
+                       seed=0, metrics_path=None):
     """``rounds`` randomized fault rounds + one clean round of the
     registry drill over SHARED prebuilt engines (compiles amortized
     across rounds; a new registry per round owns fresh schedulers).
     Each round attempts a canary deploy for the first model — the
     plans draw ``registry.load``, so some deploys fail and must
     auto-roll-back without touching live traffic — then runs
-    mixed-model mixed-priority traffic and resolves the rollout
-    (promote on even rounds, rollback on odd). The clean round must
-    deploy + promote cleanly with per-model accounting identity,
-    zero stranded futures, and per-engine executables back at the
-    documented bucket count."""
+    mixed-model mixed-priority traffic and resolves the rollout. With
+    ``guardian=True`` (the default) every round runs under a live
+    :class:`~raft_tpu.serving.guardian.SLOGuardian` owning the
+    verdict, the plans additionally draw the ``guardian.decide`` site
+    (a guardian that raises or hangs must strand nothing and never
+    leave a half-rolled canary — the violations check pins it), and
+    the clean round must end in a guardian auto-promote; with
+    ``guardian=False`` rounds resolve manually (promote on even,
+    rollback on odd). Either way the clean round needs per-model
+    accounting identity, zero stranded futures, and per-engine
+    executables back at the documented bucket count."""
     from raft_tpu.serving.engine import RAFTEngine
 
     rng = random.Random(seed)
@@ -731,6 +822,28 @@ def run_registry_chaos(models, *, shapes, rounds=2, requests=16,
     all_engines["_canary"] = canary_engine
     documented = {name: len(eng._compiled)
                   for name, eng in all_engines.items()}
+    if guardian:
+        # drill-sized bake defaults: judgeable within one round's
+        # traffic, margins wide enough that only the drill's own
+        # injected faults (not CPU latency jitter) can breach. Caller
+        # overrides MERGE on top — a --slo/--bake-ms spec must not
+        # silently resurrect the production min_requests=20 against a
+        # dozen-request round (seen live: every clean round rolled
+        # back insufficient_traffic)
+        overrides = guardian_policy or {}
+        guardian_policy = {**{"bake_window_s": 0.5, "max_bake_s": 6.0,
+                              "min_requests": 1, "p99_ratio": 4.0,
+                              "p99_slack_ms": 500.0,
+                              "err_rate_margin": 0.3, "max_wedged": 1,
+                              "max_breaker_opens": 2},
+                           **overrides}
+        if "max_bake_s" not in overrides:
+            # a caller-sized bake window (--bake-ms) must not collide
+            # with the drill default ceiling (GuardianPolicy rejects
+            # max_bake_s < bake_window_s)
+            guardian_policy["max_bake_s"] = max(
+                guardian_policy["max_bake_s"],
+                4.0 * guardian_policy["bake_window_s"])
     common = dict(shapes=shapes, requests=requests,
                   submitters=submitters, bucket_batch=bucket_batch,
                   iters=iters, priority_mix=priority_mix,
@@ -742,13 +855,18 @@ def run_registry_chaos(models, *, shapes, rounds=2, requests=16,
                   breaker_failures=breaker_failures,
                   breaker_backoff_s=breaker_backoff_s,
                   breaker_backoff_max_s=breaker_backoff_max_s,
+                  guardian=guardian, guardian_policy=guardian_policy,
+                  guardian_timeout_s=guardian_timeout_s,
+                  admission_budget=admission_budget,
+                  admission_reserve=admission_reserve,
                   metrics_path=metrics_path, engines=engines,
                   canary_engine=canary_engine)
     per_round = []
     violations = []
+    sites = (CHAOS_SITES_GUARDIAN if guardian
+             else CHAOS_SITES_REGISTRY)
     for r in range(rounds):
-        plan = chaos_plan(rng, hang_s=hang_s,
-                          sites=CHAOS_SITES_REGISTRY)
+        plan = chaos_plan(rng, hang_s=hang_s, sites=sites)
         if r == 0:
             # every chaos run proves the auto-rollback contract at
             # least once: round 0's deploy is FORCED to fail at
@@ -782,6 +900,13 @@ def run_registry_chaos(models, *, shapes, rounds=2, requests=16,
     if not s["canary"]["deployed"] or s["canary"]["resolution"] is None:
         violations.append("clean round: canary deploy/promote did not "
                           "complete")
+    elif guardian and s["canary"]["resolution"] != "guardian_promote":
+        # the clean round's canary bakes with zero injected faults: the
+        # guardian must judge it clean and auto-promote — anything else
+        # (rollback, undecided) is a broken judgment loop
+        violations.append(
+            "clean round: guardian resolution "
+            f"{s['canary']['resolution']!r} != guardian_promote")
     if s["served"] != s["accepted"]:
         violations.append("clean round: served != accepted traffic")
     for name, eng in all_engines.items():
@@ -800,6 +925,20 @@ def run_registry_chaos(models, *, shapes, rounds=2, requests=16,
                "auto_rolled_back": sum(
                    1 for p in per_round
                    if p["canary"]["deploy_failed"] is not None)}
+    guardian_summary = None
+    if guardian:
+        guardian_summary = {
+            "decisions": sum(len(p["guardian"]["decisions"])
+                             for p in per_round if p["guardian"]),
+            "errors": sum(p["guardian"]["errors"]
+                          for p in per_round if p["guardian"]),
+            "wedged_rounds": sum(1 for p in per_round
+                                 if p["guardian"]
+                                 and p["guardian"]["wedged"]),
+            "undecided_rounds": sum(
+                1 for p in per_round
+                if p["canary"]["resolution"] == "guardian_undecided"),
+        }
     return {
         "chaos_rounds": rounds,
         "registry": True,
@@ -808,9 +947,46 @@ def run_registry_chaos(models, *, shapes, rounds=2, requests=16,
         "executables": {name: len(eng._compiled)
                         for name, eng in all_engines.items()},
         "deploys": deploys,
+        "guardian": guardian_summary,
         "totals": totals,
         "per_round": per_round,
     }
+
+
+#: --slo spec keys → GuardianPolicy kwargs (floats unless noted)
+_SLO_KEYS = {"p99_ms": "p99_ceiling_ms", "p99_ratio": "p99_ratio",
+             "p99_slack_ms": "p99_slack_ms",
+             "err_rate": "err_rate_margin",
+             "min_requests": "min_requests", "wedged": "max_wedged",
+             "breaker_opens": "max_breaker_opens"}
+_SLO_INT_KEYS = ("min_requests", "wedged", "breaker_opens")
+
+
+def _parse_slo(spec: str) -> dict:
+    """``--slo`` spec → GuardianPolicy kwargs: a comma list of
+    ``key:value`` pairs, e.g. ``p99_ms:500,err_rate:0.05`` (absolute
+    canary p99 ceiling + error-rate margin over live) or
+    ``p99_ratio:2.0,wedged:0``. Unknown keys exit with usage — a typo
+    must not silently run an unguarded bake."""
+    out = {}
+    for part in spec.split(","):
+        if not part:
+            continue
+        key, sep, val = part.partition(":")
+        dest = _SLO_KEYS.get(key.strip())
+        if not sep or dest is None:
+            raise SystemExit(
+                f"--slo {spec!r}: expected comma-separated key:value "
+                f"pairs with keys from {sorted(_SLO_KEYS)} "
+                "(e.g. p99_ms:500,err_rate:0.05)")
+        try:
+            out[dest] = (int(val) if key.strip() in _SLO_INT_KEYS
+                         else float(val))
+        except ValueError:
+            raise SystemExit(
+                f"--slo {spec!r}: {key.strip()!r} needs a number, "
+                f"got {val!r}")
+    return out
 
 
 def main(argv=None):
@@ -889,6 +1065,35 @@ def main(argv=None):
                    help="with --models: interactive:batch request "
                         "counts per cycle of each submitter's "
                         "traffic (0:0 = priority-less)")
+    p.add_argument("--guardian", action="store_true",
+                   help="with --models: an SLOGuardian owns the "
+                        "rollout verdict — it bakes the canary "
+                        "against the live variant's window metrics "
+                        "and auto-promotes (clean) or auto-rolls-back "
+                        "(SLO breach); the summary gains a guardian "
+                        "block (decisions + evidence). With --chaos "
+                        "the plans also draw guardian.decide")
+    p.add_argument("--slo", default=None, metavar="K:V,...",
+                   help="guardian SLO margins as key:value pairs "
+                        "(keys: p99_ms absolute canary p99 ceiling, "
+                        "p99_ratio/p99_slack_ms vs live, err_rate "
+                        "margin over live, min_requests, wedged, "
+                        "breaker_opens), e.g. p99_ms:500,err_rate:0.05")
+    p.add_argument("--bake-ms", type=float, default=2000.0,
+                   help="guardian bake window before a clean canary "
+                        "auto-promotes (max bake = 4x)")
+    p.add_argument("--admission-budget", type=int, default=0,
+                   metavar="N",
+                   help="with --models: registry-wide admission "
+                        "budget — at most N admitted-but-unsettled "
+                        "requests across ALL models; exhaustion fails "
+                        "fast with BackpressureError, counted per "
+                        "model as admission_rejected (0: off)")
+    p.add_argument("--admission-reserve", type=int, default=None,
+                   metavar="R",
+                   help="interactive-only slice of the admission "
+                        "budget (default N/4): batch-class traffic "
+                        "can never take the last R tokens")
     p.add_argument("--log-dir", default=None,
                    help="append the metrics snapshot to "
                         "<log-dir>/metrics.jsonl")
@@ -905,6 +1110,19 @@ def main(argv=None):
               for s in args.shapes.split(",")]
     metrics_path = (os.path.join(args.log_dir, "metrics.jsonl")
                     if args.log_dir else None)
+    if (args.guardian or args.admission_budget) and not args.models:
+        raise SystemExit("--guardian/--admission-budget need --models "
+                         "(they are ModelRegistry features)")
+    guardian_policy = None
+    if args.guardian:
+        guardian_policy = _parse_slo(args.slo) if args.slo else {}
+        guardian_policy.setdefault("bake_window_s", args.bake_ms / 1e3)
+        # size the evidence floor to the drill unless --slo pinned it:
+        # the production default (min_requests=20) against a small
+        # --requests run would hold past max_bake and roll every
+        # clean canary back as insufficient_traffic
+        guardian_policy.setdefault(
+            "min_requests", max(1, min(20, args.requests // 8)))
     tiny = jnp.zeros((1, 64, 64, 3))
 
     if args.models:
@@ -954,6 +1172,14 @@ def main(argv=None):
                 gather_window_s=args.gather_ms / 1e3,
                 deadline_s=(args.deadline_ms / 1e3 if args.deadline_ms
                             else None),
+                guardian=args.guardian,
+                guardian_policy=guardian_policy,
+                # scaled like the non-chaos path: a decision can only
+                # land after the bake window — a fixed wait below it
+                # would report every clean round guardian_undecided
+                guardian_timeout_s=max(8.0, 4 * args.bake_ms / 1e3),
+                admission_budget=args.admission_budget or None,
+                admission_reserve=args.admission_reserve,
                 max_queue=args.queue, seed=args.seed,
                 metrics_path=metrics_path)
             print(json.dumps(summary), flush=True)
@@ -977,6 +1203,10 @@ def main(argv=None):
             breaker_backoff_max_s=max(args.breaker_backoff_max_ms,
                                       args.breaker_backoff_ms) / 1e3,
             wire=args.wire, pipeline_depth=args.pipeline_depth,
+            guardian=args.guardian, guardian_policy=guardian_policy,
+            guardian_timeout_s=max(30.0, 8 * args.bake_ms / 1e3),
+            admission_budget=args.admission_budget or None,
+            admission_reserve=args.admission_reserve,
             metrics_path=metrics_path, seed=args.seed)
         print(json.dumps(summary), flush=True)
         return

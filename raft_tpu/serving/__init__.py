@@ -15,10 +15,15 @@ watchdog with quarantine-and-replace, per-bucket circuit breakers,
 engine recovery, and the ``health()`` surface — and the multi-model
 registry (``registry.py``): versioned engines per named model, canary
 rollout with deterministic hash routing, promote/rollback with zero
-stranded futures.
+stranded futures — supervised by the SLO guardian (``guardian.py``):
+automated canary judgment over bake-window metrics with auto-promote/
+auto-rollback, plus the registry-wide admission budget that keeps one
+model's flood out of every other model's queue headroom.
 """
 
 from raft_tpu.serving.engine import SHAPE_ENVELOPE_LINUX, RAFTEngine
+from raft_tpu.serving.guardian import (AdmissionBudget, GuardianPolicy,
+                                       SLOGuardian)
 from raft_tpu.serving.metrics import LatencyHistogram, ServingMetrics
 from raft_tpu.serving.registry import (DeployError, ModelRegistry,
                                        RolloutInProgress, UnknownModel,
@@ -39,4 +44,5 @@ __all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX", "MicroBatchScheduler",
            "DispatchExecutor", "DispatchWedged", "ModelRegistry",
            "DeployError", "RolloutInProgress", "UnknownModel",
            "canary_hash_fraction", "PRIORITY_INTERACTIVE",
-           "PRIORITY_BATCH"]
+           "PRIORITY_BATCH", "SLOGuardian", "GuardianPolicy",
+           "AdmissionBudget"]

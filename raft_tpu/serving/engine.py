@@ -56,10 +56,11 @@ class PendingBatch:
     """
 
     __slots__ = ("bucket", "h2d_bytes", "t_ready", "_flow", "_flow_low",
-                 "_crop", "_return_low", "_low_device", "_inputs")
+                 "_crop", "_return_low", "_low_device", "_inputs",
+                 "_donated")
 
     def __init__(self, flow, flow_low, crop, bucket, h2d_bytes,
-                 return_low, low_device, inputs=None):
+                 return_low, low_device, inputs=None, donated=False):
         self._flow = flow
         self._flow_low = flow_low
         self._crop = crop           # (b, h, w, top, left, hp, wp)
@@ -75,6 +76,11 @@ class PendingBatch:
         #: exists to remove. fetch() releases them once the results are
         #: ready, when deletion is free.
         self._inputs = inputs
+        #: True when this dispatch DONATED an input (the u8 warm
+        #: engine's flow_init -> flow_low alias): fetch() must then
+        #: hand the caller a flow_low decoupled from the aliased
+        #: buffer — see the pinning note there
+        self._donated = donated
         self.t_ready: Optional[float] = None
 
     def fetch(self):
@@ -99,6 +105,26 @@ class PendingBatch:
             # align padding is identical for the next same-shape frame,
             # so this feeds straight back as its flow_init
             low = self._flow_low[:b, :hp // 8, :wp // 8, :]
+            if self._donated:
+                # On a donating engine flow_low IS the donated
+                # flow_init buffer (input_output_alias), and a
+                # full-extent crop short-circuits to the SAME array —
+                # without this pin the caller's flow_low (device
+                # handle or the host np.asarray VIEW below) aliases a
+                # donation-target buffer whose owning references this
+                # method is about to drop. Under whole-suite
+                # allocation pressure that read garbage (the PR-8
+                # donated-buffer landmine family; order-dependent
+                # test_serving failure). Decouple: copy ONLY when the
+                # crop short-circuited (a partial crop already made a
+                # fresh buffer), and force the result READY either way
+                # — its read of the donated buffer must complete while
+                # _flow_low/_inputs still pin it. Cheap: the
+                # executable just finished (flow was read above), so
+                # this blocks only on a 1/8-res slice/copy dispatch.
+                if low is self._flow_low:
+                    low = jnp.array(low, copy=True)
+                low.block_until_ready()
             if not self._low_device:
                 low = np.asarray(low)
             out = (flow, low)
@@ -556,7 +582,9 @@ class RAFTEngine:
             flow_low, flow = None, out
         return PendingBatch(flow, flow_low,
                             (b, h, w, top, left, hp, wp), bucket, h2d,
-                            return_low, low_device, inputs=args)
+                            return_low, low_device, inputs=args,
+                            donated=(self.warm_start
+                                     and self.wire == "u8"))
 
     def infer_batch(self, image1, image2, flow_init=None,
                     return_low: bool = False):

@@ -1,0 +1,490 @@
+"""SLO guardian: automated canary judgment + registry-wide admission.
+
+The registry (serving/registry.py) can roll a model out and back, but
+every rollout decision is a human call and every overload decision is
+per-variant: a bad canary keeps serving its hash fraction until an
+operator notices, and one model's batch flood can exhaust the
+aggregate queue capacity another model's interactive traffic needs.
+Production flow-serving front-ends (the TensorRT path the reference
+targets, Clipper-style adaptive model selection — PAPERS.md) treat
+automated rollback and admission control as the baseline for
+unattended operation. This module closes those two loops, jax-free:
+
+:class:`SLOGuardian`
+    A control loop over the per-variant metrics the registry already
+    emits. When a model grows a canary, the guardian opens a **bake
+    window**: it freezes a baseline snapshot of the live and canary
+    variants and, on every tick, compares the two *windows* (deltas of
+    the cumulative counters and latency-histogram counts — not
+    lifetime aggregates, which would dilute a fresh regression under
+    an old variant's history). A canary that breaches the
+    :class:`GuardianPolicy` SLOs — p99 latency beyond the live
+    variant's with margin, error rate beyond live's with margin, any
+    wedge verdict or breaker trip beyond the allowance — is
+    auto-``rollback()``ed the moment the breach is statistically
+    admissible (``min_requests``); a canary that bakes clean through
+    the window is auto-``promote()``d. Both land through the
+    registry's consequences-before-futures discipline: routing off
+    first, drains settle every accepted future, and the decision event
+    (``guardian_promote`` / ``guardian_rollback``) carries the
+    deciding evidence windows into metrics.jsonl. The clock and the
+    metrics reader are injectable, so bake drills run deterministically
+    with a synthetic clock and synthetic snapshots; the
+    ``guardian.decide`` fault site (testing/faults) arms the chaos
+    question — a guardian that raises or hangs mid-decision must leave
+    routing exactly as it found it (the site fires *before* the
+    registry mutates anything).
+
+:class:`AdmissionBudget`
+    A shared token bucket across every model in a registry
+    (``ModelRegistry(admission_budget=N)``), gating ``submit()``
+    *before* the per-variant queues. Each admitted request holds one
+    token until its future settles; with no token free the submit
+    fails fast with the scheduler's ``BackpressureError`` (counted per
+    model as ``admission_rejected``). Priority-aware draw: the last
+    ``interactive_reserve`` tokens are interactive-only — a batch
+    flood on one model can saturate its own queue but can never take
+    the whole registry's headroom, so another model's interactive
+    traffic still admits. Defaults OFF: with no budget configured the
+    registry's submit path is bitwise the PR-9 stack.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from raft_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from raft_tpu.serving.scheduler import PRIORITY_BATCH
+from raft_tpu.testing.faults import fault_point
+
+
+class GuardianPolicy:
+    """The SLO contract a canary must hold through its bake window.
+
+    ``bake_window_s``
+        Minimum bake time before a clean canary promotes.
+    ``max_bake_s``
+        Hard ceiling on the bake (default ``4 * bake_window_s``): a
+        canary that still hasn't seen ``min_requests`` by then rolls
+        back as ``insufficient_traffic`` — an unjudgeable canary must
+        not serve a hash fraction forever.
+    ``min_requests``
+        Requests (completed + failed) the canary window needs before
+        any verdict; breaches are judged as soon as it is met, clean
+        promotion additionally waits out ``bake_window_s``. The
+        relative SLOs (p99 ratio, err-rate margin) additionally need
+        the LIVE window to hold this many requests — an empty
+        baseline judges nothing (its p99/err_rate read 0 and the
+        bounds would collapse to the bare margins).
+    ``p99_ratio`` / ``p99_slack_ms``
+        Latency SLO relative to live: breach when canary window p99 >
+        live window p99 * ratio + slack (the slack absorbs histogram
+        quantization at fast-SLO scales).
+    ``p99_ceiling_ms``
+        Optional absolute canary p99 bound (None = off) — the
+        ``--slo p99_ms:...`` knob for deployments with a hard latency
+        contract independent of live's current behavior.
+    ``err_rate_margin``
+        Breach when canary window error rate > live window error rate
+        + margin (failed / (completed + failed)).
+    ``max_wedged`` / ``max_breaker_opens``
+        Allowance for wedge verdicts and breaker ``open`` transitions
+        in the canary window (default 0: any wedge or trip is a
+        breach — those are the scheduler's own SLO alarms).
+    """
+
+    __slots__ = ("bake_window_s", "max_bake_s", "min_requests",
+                 "p99_ratio", "p99_slack_ms", "p99_ceiling_ms",
+                 "err_rate_margin", "max_wedged", "max_breaker_opens")
+
+    def __init__(self, bake_window_s: float = 30.0,
+                 max_bake_s: Optional[float] = None,
+                 min_requests: int = 20, p99_ratio: float = 1.5,
+                 p99_slack_ms: float = 50.0,
+                 p99_ceiling_ms: Optional[float] = None,
+                 err_rate_margin: float = 0.02, max_wedged: int = 0,
+                 max_breaker_opens: int = 0):
+        if bake_window_s <= 0:
+            raise ValueError(f"bake_window_s={bake_window_s}: must be > 0")
+        if min_requests < 1:
+            raise ValueError(f"min_requests={min_requests}: must be >= 1")
+        if p99_ratio <= 0:
+            raise ValueError(f"p99_ratio={p99_ratio}: must be > 0")
+        if not 0.0 <= err_rate_margin <= 1.0:
+            raise ValueError(f"err_rate_margin={err_rate_margin}: "
+                             "must be in [0, 1]")
+        self.bake_window_s = float(bake_window_s)
+        self.max_bake_s = (float(max_bake_s) if max_bake_s is not None
+                           else 4.0 * self.bake_window_s)
+        if self.max_bake_s < self.bake_window_s:
+            raise ValueError(
+                f"max_bake_s={self.max_bake_s} below bake_window_s="
+                f"{self.bake_window_s}: the bake could never finish")
+        self.min_requests = int(min_requests)
+        self.p99_ratio = float(p99_ratio)
+        self.p99_slack_ms = float(p99_slack_ms)
+        self.p99_ceiling_ms = (float(p99_ceiling_ms)
+                               if p99_ceiling_ms is not None else None)
+        self.err_rate_margin = float(err_rate_margin)
+        self.max_wedged = int(max_wedged)
+        self.max_breaker_opens = int(max_breaker_opens)
+
+
+def window_stats(cur: Dict, base: Dict) -> Dict:
+    """One variant's bake-window view: the delta of two cumulative
+    variant snapshots (serving/metrics.py schema). Counters subtract;
+    the latency histogram subtracts COUNTS bucket-by-bucket, so the
+    window p99 is the window's, not the variant lifetime's."""
+    completed = cur["completed"] - base["completed"]
+    failed = cur["failed"] - base["failed"]
+    requests = completed + failed
+    h = LatencyHistogram()
+    h.counts = [c - b for c, b in zip(cur["latency"]["counts"],
+                                      base["latency"]["counts"])]
+    h.count = sum(h.counts)
+    h.max = cur["latency"]["max_ms"]   # lifetime max: pessimistic tail
+    cur_r, base_r = cur["resilience"], base["resilience"]
+    return {
+        "requests": requests,
+        "completed": completed,
+        "failed": failed,
+        "err_rate": round(failed / requests, 4) if requests else 0.0,
+        "p99_ms": h.quantile(0.99),
+        "wedged": cur_r["wedged"] - base_r["wedged"],
+        "breaker_opens": (cur_r["breaker_transitions"]["open"]
+                          - base_r["breaker_transitions"]["open"]),
+    }
+
+
+class _Bake:
+    """One canary's bake in progress: start time + frozen baselines."""
+
+    __slots__ = ("version", "t0", "live0", "canary0")
+
+    def __init__(self, version: str, t0: float, live0: Dict,
+                 canary0: Dict):
+        self.version = version
+        self.t0 = t0
+        self.live0 = live0
+        self.canary0 = canary0
+
+
+class SLOGuardian:
+    """Autonomous canary judgment over a :class:`ModelRegistry`.
+
+    ``registry`` needs the registry surface only (``snapshot()``,
+    ``promote()``, ``rollback()``, ``metrics_path``) — drills run it
+    against fakes. ``reader`` overrides the metrics source (defaults
+    to ``registry.snapshot``; must return the registry-snapshot
+    shape); ``clock`` overrides time (``time.monotonic``). Both are
+    the determinism knobs the bake drills inject.
+
+    Drive it either way:
+
+    - ``start()`` / ``stop()``: a daemon thread calls :meth:`tick`
+      every ``poll_s`` — the unattended mode. A tick that raises is
+      recorded (``guardian_error``) and the loop survives; a tick that
+      hangs (the ``guardian.decide`` chaos site) leaves routing
+      untouched — the site fires before any registry mutation — and
+      ``stop()`` times out rather than blocking shutdown.
+    - :meth:`tick` directly: deterministic drills advance the injected
+      clock and tick by hand.
+
+    Every decision is appended to :attr:`decisions` and emitted as a
+    ``guardian_promote`` / ``guardian_rollback`` event carrying the
+    deciding evidence (both windows + thresholds) into the registry's
+    metrics.jsonl.
+    """
+
+    def __init__(self, registry, policy: Optional[GuardianPolicy] = None,
+                 *, poll_s: float = 0.5,
+                 clock: Callable[[], float] = time.monotonic,
+                 reader: Optional[Callable[[], Dict]] = None,
+                 metrics: Optional[ServingMetrics] = None):
+        self.registry = registry
+        self.policy = policy or GuardianPolicy()
+        self.poll_s = float(poll_s)
+        self._clock = clock
+        self._reader = reader if reader is not None else registry.snapshot
+        self._metrics = metrics or ServingMetrics(
+            getattr(registry, "metrics_path", None), namespace="guardian")
+        #: _lock guards bake/decision state; _tick_lock serializes
+        #: whole ticks (a manual tick racing the loop must not judge
+        #: the same window twice)
+        self._lock = threading.Lock()
+        self._decided = threading.Condition(self._lock)
+        self._tick_lock = threading.Lock()
+        self._bakes: Dict[str, _Bake] = {}
+        self.decisions: List[Dict] = []
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- judgment ----------------------------------------------------------
+
+    def _breaches(self, live_w: Dict, can_w: Dict) -> List[str]:
+        """Which SLOs the canary window breaches vs the live window.
+        The RELATIVE checks (vs live's window) only judge when the
+        live window itself holds ``min_requests`` — against an empty
+        or near-empty baseline, live's p99/err_rate read as 0 and the
+        bounds would collapse to the bare margins, rolling back a
+        healthy canary (think canary_fraction 0.9, or a live-traffic
+        lull). The absolute checks (ceiling, wedges, breaker trips)
+        need no baseline and always judge."""
+        p = self.policy
+        out = []
+        live_judgeable = live_w["requests"] >= p.min_requests
+        if (live_judgeable and can_w["err_rate"]
+                > live_w["err_rate"] + p.err_rate_margin):
+            out.append(f"err_rate {can_w['err_rate']} > live "
+                       f"{live_w['err_rate']} + {p.err_rate_margin}")
+        bound = live_w["p99_ms"] * p.p99_ratio + p.p99_slack_ms
+        if live_judgeable and can_w["p99_ms"] > bound:
+            out.append(f"p99_ms {can_w['p99_ms']} > live "
+                       f"{live_w['p99_ms']} * {p.p99_ratio} + "
+                       f"{p.p99_slack_ms}")
+        if (p.p99_ceiling_ms is not None
+                and can_w["p99_ms"] > p.p99_ceiling_ms):
+            out.append(f"p99_ms {can_w['p99_ms']} > ceiling "
+                       f"{p.p99_ceiling_ms}")
+        if can_w["wedged"] > p.max_wedged:
+            out.append(f"wedged {can_w['wedged']} > {p.max_wedged}")
+        if can_w["breaker_opens"] > p.max_breaker_opens:
+            out.append(f"breaker_opens {can_w['breaker_opens']} > "
+                       f"{p.max_breaker_opens}")
+        return out
+
+    @staticmethod
+    def _canary_version(canary_blk: Dict) -> str:
+        # the canary snapshot is namespaced "model@version"
+        ns = str(canary_blk.get("model", ""))
+        return ns.rpartition("@")[2] or "?"
+
+    def tick(self) -> List[Dict]:
+        """One guardian pass over every model; returns the decisions
+        it executed (possibly empty). Safe to call concurrently with
+        the polling loop (whole ticks are serialized)."""
+        with self._tick_lock:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> List[Dict]:
+        snap = self._reader()
+        now = self._clock()
+        decisions: List[Dict] = []
+        for name in sorted(snap):
+            blk = snap[name]
+            canary = blk.get("canary")
+            if canary is None:
+                # no rollout (or the operator resolved it themselves):
+                # any bake we were tracking is over
+                with self._lock:
+                    self._bakes.pop(name, None)
+                continue
+            version = self._canary_version(canary)
+            with self._lock:
+                bake = self._bakes.get(name)
+                if bake is None or bake.version != version:
+                    bake = _Bake(version, now, blk["live"], canary)
+                    self._bakes[name] = bake
+                    new_bake = True
+                else:
+                    new_bake = False
+            if new_bake:
+                self._metrics.record_event(
+                    "guardian_bake_start", model=name, version=version,
+                    bake_window_s=self.policy.bake_window_s)
+                continue
+            window_s = now - bake.t0
+            live_w = window_stats(blk["live"], bake.live0)
+            can_w = window_stats(canary, bake.canary0)
+            evidence = {"window_s": round(window_s, 3), "live": live_w,
+                        "canary": can_w}
+            breaches = (self._breaches(live_w, can_w)
+                        if can_w["requests"] >= self.policy.min_requests
+                        else [])
+            if breaches:
+                decisions.append(self._decide(
+                    name, version, "rollback",
+                    "; ".join(breaches), evidence))
+            elif (window_s >= self.policy.bake_window_s
+                    and can_w["requests"] >= self.policy.min_requests):
+                decisions.append(self._decide(
+                    name, version, "promote", "clean bake", evidence))
+            elif window_s >= self.policy.max_bake_s:
+                decisions.append(self._decide(
+                    name, version, "rollback",
+                    f"insufficient_traffic ({can_w['requests']} < "
+                    f"{self.policy.min_requests} requests in "
+                    f"{round(window_s, 1)}s)", evidence))
+            # else: still baking — hold, judge again next tick
+        return decisions
+
+    def _decide(self, model: str, version: str, action: str,
+                reason: str, evidence: Dict) -> Dict:
+        """Execute one verdict through the registry. The chaos site
+        fires FIRST: a guardian that raises or hangs here has mutated
+        nothing — canary routing, drains and futures are exactly as
+        the registry left them (never half-rolled)."""
+        fault_point("guardian.decide")
+        decision = {"model": model, "version": version,
+                    "action": action, "reason": reason,
+                    "evidence": evidence}
+        try:
+            if action == "promote":
+                out = self.registry.promote(model)
+                decision["mode"] = out.get("mode")
+            else:
+                self.registry.rollback(model)
+        except Exception as exc:
+            # raced an operator's own promote/rollback/close: the
+            # registry refused — record, drop the bake, move on. The
+            # failed decision still lands in self.decisions (and wakes
+            # wait_decision): the rollout IS resolved, and a waiter
+            # sleeping out its full timeout to report "undecided"
+            # would be strictly less true
+            decision["action"] = "failed"
+            decision["intended"] = action
+            decision["error"] = f"{type(exc).__name__}: {exc}"
+            with self._decided:
+                self._bakes.pop(model, None)
+                self.decisions.append(decision)
+                self._decided.notify_all()
+            self._metrics.record_event(
+                "guardian_decision_failed", model=model, version=version,
+                intended=action, error=decision["error"])
+            return decision
+        with self._decided:
+            self._bakes.pop(model, None)
+            self.decisions.append(decision)
+            self._decided.notify_all()
+        self._metrics.record_event(
+            f"guardian_{action}", model=model, version=version,
+            reason=reason, evidence=evidence)
+        return decision
+
+    def wait_decision(self, model: Optional[str] = None,
+                      timeout: float = 30.0) -> Optional[Dict]:
+        """Block until the guardian resolves a verdict (for ``model``
+        if given) — an executed promote/rollback, or a ``failed`` one
+        the registry refused (the rollout was resolved either way);
+        returns it, or None on timeout — the caller's wedged-guardian
+        escape hatch."""
+        deadline = time.monotonic() + timeout
+        with self._decided:
+            while True:
+                for d in reversed(self.decisions):
+                    if model is None or d["model"] == model:
+                        return d
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._decided.wait(remaining)
+
+    # -- the unattended loop -----------------------------------------------
+
+    def start(self) -> "SLOGuardian":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="SLOGuardian", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.tick()
+            except Exception as exc:  # a failed tick must not kill the
+                self.errors += 1      # loop — record and keep watching
+                self._metrics.record_event(
+                    "guardian_error",
+                    error=f"{type(exc).__name__}: {exc}")
+
+    def stop(self, timeout: float = 10.0) -> bool:
+        """Stop the loop; returns False when the thread failed to exit
+        (a tick wedged mid-hang — daemon, it leaks accountably like a
+        quarantined dispatch thread; routing is untouched because the
+        fault site precedes every registry mutation)."""
+        self._stop.set()
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
+
+    def __enter__(self) -> "SLOGuardian":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+
+class AdmissionBudget:
+    """Registry-wide overload control: a token bucket shared by every
+    model, gating ``submit()`` before the per-variant queues.
+
+    ``capacity`` tokens bound the admitted-but-unsettled requests
+    across ALL models; a request holds its token from admission until
+    its future settles (the registry releases on the future's done
+    callback). With no token available the submit fails fast with
+    ``BackpressureError`` — the same shed contract as a full queue,
+    one layer up.
+
+    Priority-aware draw (“interactive draws before batch”): the last
+    ``interactive_reserve`` tokens are off-limits to batch-class
+    requests. A batch flood can therefore hold at most ``capacity -
+    interactive_reserve`` tokens however many models it spreads over,
+    and interactive (or priority-less — default traffic is a user
+    waiting) work always finds headroom. Reserve defaults to a quarter
+    of capacity (min 1).
+    """
+
+    def __init__(self, capacity: int,
+                 interactive_reserve: Optional[int] = None):
+        capacity = int(capacity)
+        if capacity < 1:
+            raise ValueError(f"capacity={capacity}: must be >= 1")
+        if interactive_reserve is None:
+            interactive_reserve = max(1, capacity // 4)
+        interactive_reserve = int(interactive_reserve)
+        if not 0 <= interactive_reserve <= capacity:
+            raise ValueError(
+                f"interactive_reserve={interactive_reserve}: must be "
+                f"in [0, capacity={capacity}]")
+        self.capacity = capacity
+        self.interactive_reserve = interactive_reserve
+        self._lock = threading.Lock()
+        self.in_use = 0
+        self.admitted = {"interactive": 0, "batch": 0}
+        self.rejected = {"interactive": 0, "batch": 0}
+
+    def try_acquire(self, priority: Optional[str] = None) -> bool:
+        """Take one token; False = budget exhausted for this class
+        (batch-class requests additionally respect the interactive
+        reserve). Never blocks — admission control sheds, it does not
+        queue."""
+        cls = ("batch" if priority == PRIORITY_BATCH else "interactive")
+        floor = (self.interactive_reserve if cls == "batch" else 0)
+        with self._lock:
+            if self.capacity - self.in_use <= floor:
+                self.rejected[cls] += 1
+                return False
+            self.in_use += 1
+            self.admitted[cls] += 1
+            return True
+
+    def release(self) -> None:
+        with self._lock:
+            if self.in_use > 0:
+                self.in_use -= 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"capacity": self.capacity,
+                    "interactive_reserve": self.interactive_reserve,
+                    "in_use": self.in_use,
+                    "admitted": dict(self.admitted),
+                    "rejected": dict(self.rejected)}

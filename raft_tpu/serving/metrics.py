@@ -120,7 +120,9 @@ class ServingMetrics:
     ``evicted`` is the subset of shed that was already QUEUED and gave
     its slot to a higher-priority arrival (shed-batch-first; those
     futures fail, so they also count ``failed`` — the accounting
-    identity stays exact), ``deadline_missed`` is work that expired
+    identity stays exact), ``admission_rejected`` is the subset of
+    shed turned away by the registry-wide admission budget before this
+    model's queue ever saw it, ``deadline_missed`` is work that expired
     while still queued, ``abandoned_inflight`` counts dispatched
     requests the scheduler gave up on — by design never incremented;
     the acceptance drill pins it at zero.
@@ -148,6 +150,7 @@ class ServingMetrics:
         self.failed = 0
         self.shed = 0
         self.evicted = 0
+        self.admission_rejected = 0
         self.deadline_missed = 0
         self.cancelled = 0
         self._priority: Dict[str, Dict] = {}
@@ -231,6 +234,19 @@ class ServingMetrics:
             self.shed += 1
             self.evicted += 1
             self.failed += 1
+            p = self._prio(priority)
+            if p is not None:
+                p["shed"] += 1
+
+    def record_admission_rejected(self, priority: Optional[str] = None
+                                  ) -> None:
+        """Rejected by the registry-wide admission budget BEFORE this
+        model's queue (no future was created, so — like ``shed`` — it
+        never enters the accounting identity). Counted as shed too:
+        admission control is backpressure, one layer up."""
+        with self._lock:
+            self.admission_rejected += 1
+            self.shed += 1
             p = self._prio(priority)
             if p is not None:
                 p["shed"] += 1
@@ -384,6 +400,7 @@ class ServingMetrics:
                 "failed": self.failed,
                 "shed": self.shed,
                 "evicted": self.evicted,
+                "admission_rejected": self.admission_rejected,
                 "deadline_missed": self.deadline_missed,
                 "cancelled": self.cancelled,
                 "abandoned_inflight": self.abandoned_inflight,

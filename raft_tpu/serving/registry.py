@@ -60,8 +60,11 @@ from typing import Dict, List, Optional
 
 from raft_tpu.config import ITERS_EXPORT, RAFTConfig
 from raft_tpu.serving.engine import RAFTEngine
+from raft_tpu.serving.guardian import AdmissionBudget
 from raft_tpu.serving.metrics import ServingMetrics
-from raft_tpu.serving.scheduler import MicroBatchScheduler, SchedulerClosed
+from raft_tpu.serving.scheduler import (BackpressureError,
+                                        MicroBatchScheduler,
+                                        SchedulerClosed)
 from raft_tpu.testing.faults import fault_point
 
 #: variant lifecycle states (strings on purpose: they go straight into
@@ -150,6 +153,18 @@ class ModelRegistry:
 
     ``scheduler_defaults``: kwargs applied to every variant's
     ``MicroBatchScheduler`` (per-model overrides via ``add_model``).
+
+    ``admission_budget``: registry-wide overload control — a shared
+    :class:`~raft_tpu.serving.guardian.AdmissionBudget` of this many
+    tokens gates ``submit()`` across ALL models before the per-variant
+    queues (one token per admitted request, released when its future
+    settles). Exhaustion fails fast with ``BackpressureError``,
+    counted per model as ``admission_rejected``; the last
+    ``admission_interactive_reserve`` tokens (default capacity/4) are
+    interactive-only, so one model's batch flood can no longer
+    monopolize the aggregate queue capacity another model's
+    interactive traffic needs. None (default) = no gate, bitwise the
+    historical submit path.
     """
 
     #: duck-type marker (VideoSession and other layers route on it
@@ -157,13 +172,32 @@ class ModelRegistry:
     is_registry = True
 
     def __init__(self, *, metrics_path: Optional[str] = None,
+                 admission_budget: Optional[int] = None,
+                 admission_interactive_reserve: Optional[int] = None,
                  **scheduler_defaults):
         self._lock = threading.RLock()
         self._models: Dict[str, _Model] = {}
         self._metrics_path = metrics_path
         self._sched_defaults = scheduler_defaults
         self._events = ServingMetrics(metrics_path, namespace="registry")
+        self._budget = (AdmissionBudget(admission_budget,
+                                        admission_interactive_reserve)
+                        if admission_budget else None)
         self._closed = False
+
+    @property
+    def metrics_path(self) -> Optional[str]:
+        """The shared metrics.jsonl destination (None = not writing) —
+        the surface attendant layers (the SLO guardian) append their
+        own events to."""
+        return self._metrics_path
+
+    def admission_snapshot(self) -> Optional[Dict]:
+        """The shared admission budget's state (None when no budget is
+        configured): capacity, reserve, in-use tokens, per-class
+        admitted/rejected counts."""
+        return (self._budget.snapshot() if self._budget is not None
+                else None)
 
     # -- internals ---------------------------------------------------------
 
@@ -469,6 +503,30 @@ class ModelRegistry:
                          and canary_hash_fraction(m.name, route_key)
                          < m.canary_fraction)
             target = canary if to_canary else m.live
+        if self._budget is not None \
+                and not self._budget.try_acquire(priority):
+            # registry-wide admission gate, BEFORE the per-variant
+            # queue: the whole registry is over budget — shed here so
+            # one model's flood can't convert another model's queue
+            # headroom into its own backlog
+            target.scheduler.metrics.record_admission_rejected(priority)
+            raise BackpressureError(
+                f"registry admission budget exhausted "
+                f"({self._budget.capacity} requests in flight across "
+                "models) — shedding new work; retry with backoff")
+        try:
+            fut = self._submit_variant(m, target, image1, image2,
+                                       priority, kw)
+        except BaseException:
+            if self._budget is not None:
+                self._budget.release()   # nothing was admitted
+            raise
+        if self._budget is not None:
+            fut.add_done_callback(lambda _f: self._budget.release())
+        return fut
+
+    def _submit_variant(self, m: _Model, target: _Variant, image1,
+                        image2, priority: Optional[str], kw: Dict):
         try:
             return target.scheduler.submit(image1, image2,
                                            priority=priority, **kw)
@@ -533,10 +591,10 @@ class ModelRegistry:
                     executables=len(canary.engine._compiled)))
             snaps += [v.final_snapshot for v in retired
                       if v.final_snapshot is not None]
-            totals = {k: sum(s[k] for s in snaps)
+            totals = {k: sum(s.get(k, 0) for s in snaps)
                       for k in ("submitted", "completed", "failed",
-                                "shed", "evicted", "deadline_missed",
-                                "cancelled")}
+                                "shed", "evicted", "admission_rejected",
+                                "deadline_missed", "cancelled")}
             out[name] = {
                 "live": snaps[0],
                 "canary": (snaps[1] if canary is not None else None),

@@ -90,6 +90,16 @@ bit for bit. ``namespace`` prefixes breaker labels (``model/HxW``)
 and stamps metrics records when the scheduler serves one model of a
 :class:`~raft_tpu.serving.registry.ModelRegistry`.
 
+Overload control one layer up (ISSUE 10): under a registry with an
+``admission_budget``, submits are gated by a registry-WIDE token
+bucket before they ever reach this scheduler's queue — a budget
+rejection is the same ``BackpressureError`` contract as a full queue,
+counted in this scheduler's metrics as ``admission_rejected`` (a shed
+subset), and the per-queue semantics here are unchanged. The SLO
+guardian (serving/guardian.py) likewise reads this scheduler's
+metrics/health surfaces to judge canary bakes; it adds no hooks into
+the dispatch path.
+
 Observability rides along in :class:`~raft_tpu.serving.metrics.
 ServingMetrics`: per-bucket latency histograms for each stage
 (enqueue->dispatch->complete), batch occupancy, queue depth, shed and

@@ -22,9 +22,13 @@ start, so warm and cold rows share one bucket executable.
 from __future__ import annotations
 
 import itertools
-from typing import Optional
+import random
+import time
+from typing import Callable, Optional
 
 import numpy as np
+
+from raft_tpu.utils.retry import backoff_delays
 
 #: sticky route tokens for sessions over a ModelRegistry: one token per
 #: session, fixed for its lifetime, so the deterministic canary hash
@@ -47,7 +51,13 @@ class VideoSession:
                  deadline_s: Optional[float] = None,
                  model: Optional[str] = None,
                  priority: Optional[str] = None,
-                 route_key: Optional[str] = None):
+                 route_key: Optional[str] = None,
+                 retry_budget: int = 0,
+                 retry_base_s: float = 0.05,
+                 retry_max_s: float = 2.0,
+                 retry_jitter: float = 0.5,
+                 retry_rng: Optional[random.Random] = None,
+                 retry_sleep: Optional[Callable[[float], None]] = None):
         """``device_state=True`` keeps the recurrence state
         (``flow_low``) ON DEVICE between pairs: the scheduler returns a
         device array, the forward warp runs as a jitted scatter
@@ -68,7 +78,21 @@ class VideoSession:
         deterministic canary hash keeps the WHOLE stream on one
         engine — warm-start state never crosses model variants
         mid-stream. Against a plain scheduler all three stay unset and
-        the submit call is byte-identical to before."""
+        the submit call is byte-identical to before.
+
+        ``retry_budget`` > 0 makes the session absorb transient
+        submit-time rejections itself: a ``BackpressureError`` (full
+        queue or registry admission budget) or ``CircuitOpen`` retries
+        through ``utils/retry.backoff_delays`` (``retry_base_s`` /
+        ``retry_max_s`` / ``retry_jitter``; ``retry_rng`` and
+        ``retry_sleep`` injectable for deterministic drills), capped
+        at ``retry_budget`` retries over the SESSION's lifetime — a
+        stream stuck behind a persistent overload must run out, not
+        hammer. Any retried pair cold-restarts the recurrence (by the
+        time a retry lands, the warm state is stale by at least one
+        backoff), and budget exhaustion surfaces the ORIGINAL
+        exception to the caller. Default 0: rejections surface
+        immediately, the historical contract."""
         self._sched = scheduler
         self.warm_start = bool(warm_start)
         self.device_state = bool(device_state)
@@ -93,6 +117,19 @@ class VideoSession:
                 "model=/route_key= need a ModelRegistry scheduler")
         elif priority is not None:
             self._submit_kw["priority"] = priority
+        self.retry_budget = int(retry_budget)
+        self.retries_used = 0
+        self._retryable: tuple = ()
+        if self.retry_budget > 0:
+            from raft_tpu.serving.resilience import CircuitOpen
+            from raft_tpu.serving.scheduler import BackpressureError
+
+            self._retryable = (BackpressureError, CircuitOpen)
+            self._mk_delays = lambda: backoff_delays(
+                retry_base_s, retry_max_s, jitter=retry_jitter,
+                rng=retry_rng)
+            self._retry_sleep = (retry_sleep if retry_sleep is not None
+                                 else time.sleep)
         self.frames = 0
         self.warm_submits = 0
         self._prev_frame: Optional[np.ndarray] = None
@@ -157,29 +194,59 @@ class VideoSession:
                 # a garbage pair degrades to a cold start here the way
                 # the host path's isfinite guard does — without a sync.
                 flow_init = forward_interpolate_device(self._flow_low)
-                self.warm_submits += 1
             elif self._flow_low is not None:
                 from raft_tpu.ops.interp import forward_interpolate
 
                 flow_init = forward_interpolate(
                     np.asarray(self._flow_low))
-                if np.isfinite(flow_init).all():
-                    self.warm_submits += 1
-                else:
+                if not np.isfinite(flow_init).all():
                     # every forward-warped point left the frame (a
                     # garbage pair, or motion larger than the frame):
                     # griddata had nothing to interpolate from and
                     # returns NaN ('nearest' ignores fill_value) —
                     # cold-start instead of poisoning the stream
                     flow_init = None
-        fut = self._sched.submit(
-            prev, frame,
-            deadline_s=self.deadline_s if deadline_s is None
-            else deadline_s,
-            flow_init=flow_init, want_low=self.warm_start,
-            low_device=self.device_state, **self._submit_kw)
+        effective_deadline = (self.deadline_s if deadline_s is None
+                              else deadline_s)
+        try:
+            fut = self._sched.submit(
+                prev, frame, deadline_s=effective_deadline,
+                flow_init=flow_init, want_low=self.warm_start,
+                low_device=self.device_state, **self._submit_kw)
+        except self._retryable as exc:
+            fut = self._retry_submit(prev, frame, effective_deadline,
+                                     exc)
+        else:
+            if flow_init is not None:
+                self.warm_submits += 1
         self._pending = fut
         return fut
+
+    def _retry_submit(self, prev, frame,
+                      deadline_s: Optional[float], original):
+        """Absorb a retryable submit rejection within the session's
+        retry budget: jittered backoff, then resubmit the pair COLD —
+        by the time a retry lands the warm state is a backoff stale,
+        and a cold row is bitwise a fresh stream start. The budget is
+        per session and hard; exhaustion re-raises the ORIGINAL
+        rejection (the retries' own rejections carry no new
+        information)."""
+        # cold-restart the recurrence: the retried pair submits with
+        # no flow_init, and the NEXT pair must not warm off state from
+        # before the disruption either
+        self._flow_low = None
+        delays = self._mk_delays()
+        while self.retries_used < self.retry_budget:
+            self.retries_used += 1
+            self._retry_sleep(next(delays))
+            try:
+                return self._sched.submit(
+                    prev, frame, deadline_s=deadline_s,
+                    flow_init=None, want_low=self.warm_start,
+                    low_device=self.device_state, **self._submit_kw)
+            except self._retryable:
+                continue
+        raise original
 
     def drain(self) -> Optional[np.ndarray]:
         """Wait out the last pair; returns the stream's final

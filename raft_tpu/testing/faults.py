@@ -41,7 +41,14 @@ Plan entries (a list of dicts, or ``{"faults": [...]}``):
     an uncompilable arch, and the registry's contract under it is
     auto-rollback: the failed canary is discarded, ``DeployError``
     surfaces to the deployer, and the live model's traffic never
-    touches the partial variant).
+    touches the partial variant), ``guardian.decide`` (the SLO
+    guardian's verdict execution point, serving/guardian.py — fires
+    AFTER judgment but BEFORE the registry promote/rollback call, so
+    a ``raise`` aborts the decision with routing untouched (the loop
+    survives and re-judges next tick) and a ``hang`` wedges the
+    guardian thread with the canary still fully routed — the drilled
+    contract is that a wedged guardian strands no futures and never
+    leaves a half-rolled canary).
 ``at``
     1-based occurrence at which the entry becomes eligible (default 1).
     With the defaults below, each entry fires exactly once — the

@@ -516,8 +516,11 @@ class TestTwoModelAcceptanceDrill:
 
     def test_registry_chaos_soak(self, small_setup):
         """The registry chaos drill at tiny shapes: randomized fault
-        rounds (drawing registry.load) + the clean round — zero
-        violations, some deploy attempts, per-model identity."""
+        rounds (drawing registry.load AND guardian.decide — the
+        guardian owns every round's rollout verdict) + the clean
+        round — zero violations, some deploy attempts, per-model
+        identity, and the clean round's canary judged clean and
+        auto-promoted."""
         from raft_tpu.cli.serve_bench import run_registry_chaos
 
         cfg, variables = small_setup
@@ -540,3 +543,10 @@ class TestTwoModelAcceptanceDrill:
         assert summary["deploys"]["auto_rolled_back"] >= 1
         # the clean round always deploys; at least it must land
         assert summary["deploys"]["deployed"] >= 1
+        # the guardian judged at least the clean round (its promote is
+        # also pinned by the violations check), and a wedged guardian
+        # round would have shown up as a half-rolled-canary violation
+        assert summary["guardian"]["decisions"] >= 1
+        clean = summary["per_round"][-1]
+        assert clean["canary"]["resolution"] == "guardian_promote"
+        assert clean["guardian"]["wedged"] is False

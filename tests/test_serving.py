@@ -300,6 +300,47 @@ class TestU8Wire:
         warm_dev = eng.infer_batch(i1, i2, flow_init=low_dev)
         np.testing.assert_array_equal(warm_host, warm_dev)
 
+    def test_donating_fetch_returns_decoupled_flow_low(self, small_setup,
+                                                       rng):
+        """The order-dependent full-suite landmine (PR 8): on a
+        donating engine (u8 warm) flow_low IS the donated flow_init
+        buffer and a full-extent crop short-circuits to the same
+        array, so fetch() used to hand callers views/handles of a
+        donation-target buffer whose owning references it had just
+        dropped. Pin the fix: the returned flow_low (host AND device)
+        must be ready, independent storage — never the executable's
+        aliased output buffer."""
+        cfg, variables = small_setup
+        eng = RAFTEngine(variables, cfg, iters=1, envelope=[(1, 32, 32)],
+                         warm_start=True, wire="u8")
+        i1 = rng.randint(0, 256, (1, 32, 32, 3)).astype(np.uint8)
+        i2 = rng.randint(0, 256, (1, 32, 32, 3)).astype(np.uint8)
+        p = eng.infer_batch_async(i1, i2, return_low=True,
+                                  low_device=True)
+        raw_low = p._flow_low       # the aliased executable output
+        _, low_dev = p.fetch()
+        assert isinstance(low_dev, jax.Array)
+        assert low_dev is not raw_low
+        assert (low_dev.unsafe_buffer_pointer()
+                != raw_low.unsafe_buffer_pointer())
+        # host path: the numpy flow_low must not be a zero-copy VIEW
+        # of the executable's aliased output buffer (np.asarray of a
+        # CPU jax array is zero-copy — a view of the copy is fine, a
+        # view of the donation target is the landmine)
+        p3 = eng.infer_batch_async(i1, i2, return_low=True)
+        raw3 = p3._flow_low
+        _, low_host = p3.fetch()
+        assert isinstance(low_host, np.ndarray)
+        assert low_host.ctypes.data != raw3.unsafe_buffer_pointer()
+        # the f32 (non-donating) path keeps its zero-overhead contract:
+        # no copy is forced on fetch
+        f32 = RAFTEngine(variables, cfg, iters=1, envelope=[(1, 32, 32)],
+                         warm_start=True)
+        pf = f32.infer_batch_async(i1, i2, return_low=True,
+                                   low_device=True)
+        assert pf._donated is False
+        pf.fetch()
+
 
 class TestMeshServing:
     def test_sharded_engine_matches_single_device(self, small_setup, rng):

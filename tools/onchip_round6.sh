@@ -228,6 +228,27 @@ step serve_registry_r6 2400 python -m raft_tpu.cli.serve_bench \
     --deadline-ms 120000 --gather-ms 20 --iters 20 \
     --log-dir /tmp/raft_serve_registry_r6
 
+# ---- SLO guardian: unattended rollout + admission budget (PR 10) -----
+# the serve_registry_r6 traffic again, but the rollout verdict belongs
+# to the SLOGuardian: the same-arch canary bakes for 30s against the
+# live variant's window metrics (p99 ratio 2x + 500ms slack and a 5%
+# error-rate margin absorb on-chip compile jitter; real breach = real
+# rollback) and must auto-promote via weights_swap — watch the
+# summary's guardian block for the decision + evidence windows, and
+# the canary block for resolution=guardian_promote. The 32-token
+# admission budget (8 reserved interactive) also gets its first
+# real-hardware numbers: admission_rejected per model in the
+# per-model blocks. Bake sized ABOVE the traffic run so the window
+# sees the whole drill.
+step serve_guardian_r6 2400 python -m raft_tpu.cli.serve_bench \
+    --models basic,small --shapes 440x1024,368x496 --requests 48 \
+    --submitters 2 --bucket-batch 4 --priority-mix 3:1 --canary 0.25 \
+    --guardian \
+    --slo p99_ratio:2.0,p99_slack_ms:500,err_rate:0.05,min_requests:5 \
+    --bake-ms 30000 --admission-budget 32 --admission-reserve 8 \
+    --deadline-ms 120000 --gather-ms 20 --iters 20 \
+    --log-dir /tmp/raft_serve_guardian_r6
+
 # ---- trace the loser's question: where did the fused step's time go ---
 # (only worth a window slot once both A/B rungs have numbers)
 if [ -e "$MARK/bench_g_gruxla" ] && [ -e "$MARK/bench_g_grufused" ]; then
