@@ -22,6 +22,7 @@ model's flood out of every other model's queue headroom.
 """
 
 from raft_tpu.serving.engine import SHAPE_ENVELOPE_LINUX, RAFTEngine
+from raft_tpu.serving.futures import settle_future
 from raft_tpu.serving.guardian import (AdmissionBudget, GuardianPolicy,
                                        SLOGuardian)
 from raft_tpu.serving.metrics import LatencyHistogram, ServingMetrics
@@ -45,4 +46,4 @@ __all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX", "MicroBatchScheduler",
            "DeployError", "RolloutInProgress", "UnknownModel",
            "canary_hash_fraction", "PRIORITY_INTERACTIVE",
            "PRIORITY_BATCH", "SLOGuardian", "GuardianPolicy",
-           "AdmissionBudget"]
+           "AdmissionBudget", "settle_future"]

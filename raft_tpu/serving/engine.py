@@ -28,6 +28,13 @@ from raft_tpu.models import RAFT
 from raft_tpu.ops.padding import pad_amounts
 from raft_tpu.testing.faults import fault_point
 
+#: graftthread T3: the engine lock is a LEAF, and T1 is the reason it
+#: can stay one — compiles (``lower()/compile()``, minutes on real
+#: hardware) run OUTSIDE it by hard-won discipline (the PR-6 bug:
+#: compiling under this lock stalled weight swaps and every
+#: already-compiled dispatch behind one cold bucket).
+LOCK_ORDER = (("engine.RAFTEngine._lock",),)
+
 # cvt2trt.sh:1 envelope (min 1x3x256x256 / opt 2x3x800x800 / max 8x3x1024x1024)
 SHAPE_ENVELOPE_LINUX: List[Tuple[int, int, int]] = [
     (1, 256, 256), (2, 800, 800), (8, 1024, 1024)]

@@ -59,6 +59,23 @@ from raft_tpu.serving.metrics import LatencyHistogram, ServingMetrics
 from raft_tpu.serving.scheduler import PRIORITY_BATCH
 from raft_tpu.testing.faults import fault_point
 
+#: graftthread T3: a tick serializes under ``_tick_lock`` and, inside
+#: it, reads the registry (snapshot) and executes verdicts (promote/
+#: rollback take the registry lock) plus the bake/decision state under
+#: the guardian's own lock — ``_tick_lock`` is strictly outermost.
+#: The admission budget's lock is a leaf.
+LOCK_ORDER = (
+    ("guardian.SLOGuardian._tick_lock", "guardian.SLOGuardian._lock"),
+    ("guardian.SLOGuardian._tick_lock",
+     "registry.ModelRegistry._lock"),
+    ("guardian.AdmissionBudget._lock",),
+)
+
+#: ``_decided`` is a Condition OVER ``_lock`` (same underlying lock,
+#: not a second one): declare it lockish and alias it so the graph
+#: sees one node and the T1 same-receiver wait exemption applies.
+GRAFTTHREAD = {"locks": ("_decided",), "aliases": {"_decided": "_lock"}}
+
 
 class GuardianPolicy:
     """The SLO contract a canary must hold through its bake window.

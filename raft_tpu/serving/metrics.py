@@ -25,6 +25,13 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+#: graftthread T3: the metrics lock is a LEAF — record_* calls arrive
+#: from under the scheduler's queue lock (``_cv``), so taking any
+#: other serving lock in here would invert the declared order. The
+#: event appenders (record_event) deliberately do their file I/O with
+#: NO lock held (T1: no blocking I/O under a lock).
+LOCK_ORDER = (("metrics.ServingMetrics._lock",),)
+
 #: 1-2-5 log ladder, 0.1 ms .. 60 s — everything from a warm CPU
 #: dispatch to a cold-compile stall lands inside it
 _BOUNDS_MS: List[float] = [

@@ -67,6 +67,16 @@ from raft_tpu.serving.scheduler import (BackpressureError,
                                         SchedulerClosed)
 from raft_tpu.testing.faults import fault_point
 
+#: graftthread T3: the registry lock is the OUTERMOST serving lock —
+#: where it is held into a variant's scheduler at all, the direction
+#: is registry -> scheduler, never the reverse (drains, closes and
+#: health walks all release the registry lock first; a scheduler
+#: thread must never call back into a locked registry).
+LOCK_ORDER = (
+    ("registry.ModelRegistry._lock",
+     "scheduler.MicroBatchScheduler._cv"),
+)
+
 #: variant lifecycle states (strings on purpose: they go straight into
 #: health() JSON and metrics.jsonl events)
 MODEL_LOADING = "loading"

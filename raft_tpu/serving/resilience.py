@@ -39,6 +39,20 @@ from typing import Callable, List, Optional, Tuple
 from raft_tpu.testing.faults import fault_point
 from raft_tpu.utils.retry import backoff_delays
 
+#: graftthread T3: both locks here are LEAVES — nothing is ever
+#: acquired under them (the scheduler's chains name them as terminal
+#: nodes; the breaker listener contract below is what keeps it so).
+LOCK_ORDER = (
+    ("resilience.CircuitBreaker._lock",),
+    ("resilience.DispatchExecutor._lock",),
+)
+
+#: graftthread T4: transition listeners are caller-supplied code that
+#: reads OTHER locked state (the scheduler's health recompute walks
+#: the whole breaker board) — they fire via the _set/_notify split,
+#: never inside the breaker lock.
+GRAFTTHREAD = {"callbacks": ("_on_transition", "on_transition")}
+
 
 class DispatchWedged(RuntimeError):
     """A dispatch exceeded ``dispatch_timeout_s``: the watchdog failed

@@ -120,6 +120,7 @@ from typing import Deque, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from raft_tpu.ops.padding import pad_amounts
+from raft_tpu.serving.futures import settle_future
 from raft_tpu.serving.metrics import ServingMetrics
 from raft_tpu.serving.resilience import (BREAKER_CLOSED, BREAKER_OPEN,
                                          CircuitBreaker, CircuitOpen,
@@ -135,6 +136,37 @@ from raft_tpu.testing.faults import fault_point
 PRIORITY_INTERACTIVE = "interactive"
 PRIORITY_BATCH = "batch"
 _PRIORITIES = (None, PRIORITY_INTERACTIVE, PRIORITY_BATCH)
+
+#: graftthread T3 declaration (tools/graftthread): the machine-checked
+#: form of the comment discipline at ``_refresh_state`` — the health
+#: recompute holds ``_state_lock`` while reading the queue (``_cv``),
+#: the pipeline FIFO (``_pipe_lock``) and the breaker board
+#: (``peek``); ``_cv`` is held while metrics record; a completion
+#: wedge holds ``_pipe_lock`` across the executor swap. Nothing may
+#: ever acquire these in the reverse direction (the breaker fires its
+#: listeners OUTSIDE its lock precisely so ``_on_breaker`` can take
+#: ``_state_lock``).
+LOCK_ORDER = (
+    ("scheduler.MicroBatchScheduler._state_lock",
+     "scheduler.MicroBatchScheduler._cv",
+     "metrics.ServingMetrics._lock"),
+    ("scheduler.MicroBatchScheduler._state_lock",
+     "scheduler.MicroBatchScheduler._pipe_lock",
+     "resilience.DispatchExecutor._lock"),
+    ("scheduler.MicroBatchScheduler._state_lock",
+     "resilience.CircuitBreaker._lock"),
+)
+
+#: graftthread T6: wedge verdicts must land every consequence (drop
+#: the suspect executable, record the breaker failure, quarantine the
+#: stuck thread) BEFORE any future settles — a woken caller observes
+#: consistent state, never a half-applied verdict.
+GRAFTTHREAD = {
+    "verdicts": ("_wedge_verdict", "_wedge_completion"),
+    "consequences": ("drop_bucket", "record_failure",
+                     "quarantine_and_replace"),
+    "settles": ("_fail_requests",),
+}
 
 
 class BackpressureError(RuntimeError):
@@ -427,15 +459,15 @@ class MicroBatchScheduler:
                         f"queue full ({self.max_queue} pending) — "
                         "shedding new work; retry with backoff")
                 self._q.remove(victim)
-                try:
-                    victim.future.set_exception(BackpressureError(
-                        "shed by an interactive arrival under "
-                        "full-queue backpressure (batch class sheds "
-                        "first); retry with backoff"))
+                if settle_future(
+                        victim.future, BackpressureError(
+                            "shed by an interactive arrival under "
+                            "full-queue backpressure (batch class "
+                            "sheds first); retry with backoff"),
+                        # raced: the victim's caller cancelled in the
+                        # race window
+                        raced=self.metrics.record_cancelled):
                     self.metrics.record_evicted(victim.priority)
-                except InvalidStateError:
-                    # the victim's caller cancelled in the race window
-                    self.metrics.record_cancelled()
             self._q.append(req)
             if priority == PRIORITY_BATCH:
                 self._seen_batch = True
@@ -577,17 +609,17 @@ class MicroBatchScheduler:
 
     def _expire(self, req: _Request, now: float) -> bool:
         if req.deadline is not None and now > req.deadline:
-            try:
-                req.future.set_exception(DeadlineExceeded(
-                    f"deadline expired after {now - req.t_submit:.3f}s "
-                    "in queue (never dispatched)"))
-            except InvalidStateError:
-                # the caller cancelled between the cancelled() check
-                # and here — count it as the cancel it was, and don't
-                # let the race kill a submitter or the dispatcher
-                self.metrics.record_cancelled()
-                return True
-            self.metrics.record_deadline_miss(priority=req.priority)
+            if settle_future(
+                    req.future, DeadlineExceeded(
+                        f"deadline expired after "
+                        f"{now - req.t_submit:.3f}s in queue (never "
+                        "dispatched)"),
+                    # raced: the caller cancelled between the
+                    # cancelled() check and here — count it as the
+                    # cancel it was, and don't let the race kill a
+                    # submitter or the dispatcher
+                    raced=self.metrics.record_cancelled):
+                self.metrics.record_deadline_miss(priority=req.priority)
             return True
         return False
 
@@ -678,11 +710,8 @@ class MicroBatchScheduler:
         for r in requests:
             if r.future.done():
                 continue
-            try:
-                r.future.set_exception(exc)
+            if settle_future(r.future, exc):
                 n += 1
-            except InvalidStateError:
-                pass
         return n
 
     def _await_pipeline_slot(self) -> None:
@@ -1005,9 +1034,7 @@ class MicroBatchScheduler:
                 low = lows[i]
                 if not r.low_device and not isinstance(low, np.ndarray):
                     low = np.asarray(low)
-            try:
-                r.future.set_result(ServeResult(flows[i], low))
-            except InvalidStateError:
+            if not settle_future(r.future, ServeResult(flows[i], low)):
                 continue  # wedge verdict settled it first
             self.metrics.record_complete(
                 label, queue_ms=(t_disp - r.t_submit) * 1e3,
@@ -1215,12 +1242,9 @@ class MicroBatchScheduler:
                 exc = SchedulerClosed("dropped by no-drain close")
                 while self._q:
                     r = self._q.popleft()
-                    if not r.future.done():
-                        try:
-                            r.future.set_exception(exc)
-                            n += 1
-                        except InvalidStateError:
-                            pass
+                    if not r.future.done() \
+                            and settle_future(r.future, exc):
+                        n += 1
                 self.metrics.record_failure(n)
             self._cv.notify_all()
         self._worker.join(timeout)

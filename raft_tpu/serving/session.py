@@ -30,6 +30,12 @@ import numpy as np
 
 from raft_tpu.utils.retry import backoff_delays
 
+# graftthread: no declarations — this module owns NO locks by design
+# (a session is single-submitter by contract; cross-stream concurrency
+# lives in the scheduler's queue), so there is nothing to order, fire,
+# or verdict here. Keep it that way: adding a lock to session state
+# means the contract broke.
+
 #: sticky route tokens for sessions over a ModelRegistry: one token per
 #: session, fixed for its lifetime, so the deterministic canary hash
 #: routes the WHOLE stream to one variant — a warm-start flow_init must

@@ -57,8 +57,16 @@ class HangWatch:
     def beat(self) -> None:
         self._last = time.monotonic()
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 2.0) -> None:
+        """Disarm AND reap the watcher (graftthread T5: a thread
+        nobody joins is a leak). Cheap: the poll loop's ``Event.wait``
+        wakes the moment the stop flag sets, so the join returns in
+        milliseconds, not ``interval``. Self-join guarded — an
+        ``on_fire`` callback may itself call stop()."""
         self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout)
 
     def _fire(self, stale: float) -> None:
         if self._on_fire is not None:

@@ -1,0 +1,34 @@
+"""T1 negative: the same operations, held-lock discipline respected."""
+
+import threading
+import time
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._compiled = {}
+
+    def get_executable(self, fn, shape):
+        with self._lock:
+            exe = self._compiled.get(shape)   # dict .get: not a queue
+        if exe is None:
+            # compile OUTSIDE the lock; first insert wins the race
+            exe = fn.lower(shape).compile()
+            with self._lock:
+                exe = self._compiled.setdefault(shape, exe)
+        return exe
+
+    def wait_ready(self, timeout):
+        with self._cv:
+            # waiting on the HELD Condition releases it — the one
+            # legal blocking wait under a lock
+            self._cv.wait(timeout)
+
+    def deferred_cleanup(self):
+        with self._lock:
+            def later():            # a closure runs LATER, lock-free
+                time.sleep(0.1)
+            self._compiled.clear()
+            return later

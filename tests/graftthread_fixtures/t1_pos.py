@@ -1,0 +1,20 @@
+"""T1 positive: blocking calls lexically inside a lock body."""
+
+import threading
+import time
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._compiled = {}
+
+    def get_executable(self, fn, shape, fut, worker, mailbox):
+        with self._lock:
+            exe = fn.lower(shape).compile()   # XLA compile under lock
+            self._compiled[shape] = exe
+            time.sleep(0.1)                   # sleep under lock
+            _ = fut.result()                  # Future wait under lock
+            worker.join()                     # thread wait under lock
+            _ = mailbox.get()                 # queue read under lock
+        return exe

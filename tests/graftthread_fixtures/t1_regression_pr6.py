@@ -1,0 +1,29 @@
+"""The PR-6 compile-under-engine-lock bug, distilled pre-fix.
+
+The original engine compiled bucket executables INSIDE the engine
+lock: a minutes-long XLA compile for one cold bucket stalled every
+live weight swap and every already-compiled dispatch queued behind the
+lock. PR 6's review moved ``lower()/compile()`` outside (first insert
+wins the duplicate-compile race); this fixture preserves the pre-fix
+shape so the T1 rule is demonstrably red on it — the regression anchor
+for the whole rule.
+"""
+
+import threading
+
+
+class RAFTEngineBug:
+    def __init__(self, fn):
+        self._fn = fn
+        self._lock = threading.RLock()
+        self._compiled = {}
+
+    def _get_executable(self, shape, args):
+        with self._lock:
+            exe = self._compiled.get(shape)
+            if exe is None:
+                # THE BUG: weight swaps and every compiled-bucket
+                # dispatch now wait out this compile
+                exe = self._fn.lower(*args).compile()
+                self._compiled[shape] = exe
+            return exe

@@ -1,0 +1,23 @@
+"""T2 negative: a declared settle-helper module — the raw calls live
+inside ``settle_future`` and every other site routes through it."""
+
+from concurrent.futures import InvalidStateError
+
+GRAFTTHREAD = {"settle_helper": True}
+
+
+def settle_future(fut, result_or_exc, raced=None):
+    try:
+        if isinstance(result_or_exc, BaseException):
+            fut.set_exception(result_or_exc)
+        else:
+            fut.set_result(result_or_exc)
+    except InvalidStateError:
+        if raced is not None:
+            raced()
+        return False
+    return True
+
+
+def fail_all(requests, exc):
+    return sum(settle_future(r.future, exc) for r in requests)

@@ -1,0 +1,18 @@
+"""T2 positive: raw future settles — the pre-PR-11 copy-paste idiom."""
+
+from concurrent.futures import InvalidStateError
+
+
+def fail_all(requests, exc):
+    n = 0
+    for r in requests:
+        try:
+            r.future.set_exception(exc)     # raw settle
+            n += 1
+        except InvalidStateError:
+            pass
+    return n
+
+
+def finish(fut, value):
+    fut.set_result(value)                   # raw settle, not even guarded

@@ -1,0 +1,25 @@
+"""T3 negative: every nested acquisition follows the declared order."""
+
+import threading
+
+LOCK_ORDER = (
+    ("t3_neg.Board._alock", "t3_neg.Board._block",
+     "t3_neg.Board._clock"),
+)
+
+
+class Board:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self._clock = threading.Lock()
+
+    def snapshot(self):
+        with self._alock:
+            with self._block:
+                return 1
+
+    def deep(self):
+        with self._alock:
+            with self._clock:      # skipping a level is still ordered
+                return 2

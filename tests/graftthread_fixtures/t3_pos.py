@@ -1,0 +1,24 @@
+"""T3 positive: the declared order and an inferred nested-``with``
+acquisition disagree — the union graph has a cycle."""
+
+import threading
+
+LOCK_ORDER = (
+    ("t3_pos.Board._alock", "t3_pos.Board._block"),
+)
+
+
+class Board:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def snapshot(self):
+        with self._alock:
+            with self._block:      # matches the declaration
+                return 1
+
+    def inverted(self):
+        with self._block:
+            with self._alock:      # INVERSION: closes the cycle
+                return 2

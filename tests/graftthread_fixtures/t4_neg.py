@@ -1,0 +1,20 @@
+"""T4 negative: the resilience.py discipline — record the transition
+under the lock, fire the listener after releasing."""
+
+import threading
+
+GRAFTTHREAD = {"callbacks": ("on_transition",)}
+
+
+class Breaker:
+    def __init__(self, listener):
+        self._lock = threading.Lock()
+        self.on_transition = listener
+        self._state = "closed"
+
+    def trip(self):
+        with self._lock:
+            old, self._state = self._state, "open"
+            fired = (old, "open") if old != "open" else None
+        if fired is not None:
+            self.on_transition(*fired)   # outside the lock: legal

@@ -1,0 +1,19 @@
+"""T4 positive: a declared listener fired while the lock is held."""
+
+import threading
+
+GRAFTTHREAD = {"callbacks": ("on_transition",)}
+
+
+class Breaker:
+    def __init__(self, listener):
+        self._lock = threading.Lock()
+        self.on_transition = listener
+        self._state = "closed"
+
+    def trip(self):
+        with self._lock:
+            self._state = "open"
+            # arbitrary caller code re-entering locked state WITH the
+            # lock: the deadlock the _set/_notify split exists to avoid
+            self.on_transition("closed", "open")

@@ -1,0 +1,35 @@
+"""T5 negative: joined on the stop path, or quarantine-accounted."""
+
+import threading
+
+
+class Watcher:
+    def __init__(self, work):
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._thread.join(timeout=5.0)
+
+
+class Quarantiner:
+    """The DispatchExecutor discipline: a wedged thread can't be
+    killed or joined — it is abandoned, replaced, and ACCOUNTED."""
+
+    def __init__(self):
+        self.quarantined = []
+        self._thread = None
+
+    def spawn(self, work):
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.quarantined.append(self._thread)
+
+
+def helper(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout=1.0)        # armed AND reaped in the same function
+    return t

@@ -1,0 +1,17 @@
+"""T5 positive: threads nobody can reap or account for."""
+
+import threading
+
+
+class Poller:
+    """Not daemon-flagged, and the class has no stop path at all."""
+
+    def arm(self, work):
+        self._thread = threading.Thread(target=work)
+        self._thread.start()
+
+
+def run_detached(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()      # the function returns without ever joining it
+    return t

@@ -1,0 +1,19 @@
+"""T6 negative: consequences first, futures last — the PR-7 wedge
+ordering invariant."""
+
+GRAFTTHREAD = {
+    "verdicts": ("wedge_verdict", "quiet_verdict"),
+    "consequences": ("drop_bucket", "record_failure"),
+    "settles": ("fail_requests",),
+}
+
+
+class Scheduler:
+    def wedge_verdict(self, key, batch, exc):
+        self.engine.drop_bucket(key)
+        self.breaker.record_failure(wedged=True)
+        self.fail_requests(batch, exc)
+
+    def quiet_verdict(self, key):
+        # a verdict that settles nothing has nothing to order
+        self.engine.drop_bucket(key)

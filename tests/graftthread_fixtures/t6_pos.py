@@ -1,0 +1,17 @@
+"""T6 positive: a verdict that settles futures BEFORE its
+consequences land — a woken caller races the cleanup."""
+
+GRAFTTHREAD = {
+    "verdicts": ("wedge_verdict",),
+    "consequences": ("drop_bucket", "record_failure"),
+    "settles": ("fail_requests",),
+}
+
+
+class Scheduler:
+    def wedge_verdict(self, key, batch, exc):
+        # BUG: callers wake to DispatchWedged while the suspect
+        # executable is still routable and the breaker still closed
+        self.fail_requests(batch, exc)
+        self.engine.drop_bucket(key)
+        self.breaker.record_failure(wedged=True)

@@ -1,0 +1,458 @@
+"""graftthread: the thread-safety static-analysis gate (tools/graftthread/).
+
+Mirrors test_graftlint's three layers, plus the lock-graph units T3
+needs:
+
+- per-rule fixture tests: each rule T1-T6 has a positive fixture (must
+  fire) and a negative fixture (must stay silent) under
+  ``tests/graftthread_fixtures/``; the T1 positive set includes
+  ``t1_regression_pr6.py`` — the PR-6 compile-under-engine-lock bug
+  distilled pre-fix, the acceptance regression for the rule;
+- mechanism tests: per-line pragmas, baseline grandfathering +
+  stale-entry failure, the declaration convention's error surface
+  (E2), the shared content-hash parse cache;
+- lock-order units: cycle detection over SYNTHETIC declaration graphs
+  (no files involved), plus the cross-file union pass;
+- the repo gate: ``python -m tools.graftthread --json`` (default
+  paths: the serving stack + supervisor + utils, shipped baseline)
+  must exit 0 in under the 30 s warm budget, and the shipped baseline
+  must be EMPTY — initial findings were fixed (settle_future
+  migration, HangWatch join) or pragma-waived with justification,
+  never grandfathered.
+
+graftthread is pure-stdlib ``ast``; nothing here touches jax.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "graftthread_fixtures")
+BASELINE = os.path.join(REPO, "tools", "graftthread", "baseline.json")
+
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graftthread import (DEFAULT_PATHS, apply_baseline,  # noqa: E402
+                               lint_file, lint_paths, load_baseline,
+                               write_baseline)
+from tools.graftthread.core import collect_files, main  # noqa: E402
+from tools.graftthread.rules import lock_order  # noqa: E402
+
+RULES = ("T1", "T2", "T3", "T4", "T5", "T6")
+
+
+def fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+def rules_hit(path):
+    return {f.rule for f in lint_file(path)}
+
+
+class TestRuleFixtures:
+    @pytest.mark.parametrize("rule", RULES)
+    def test_positive_fixture_fires(self, rule):
+        path = fixture(f"{rule.lower()}_pos.py")
+        assert rule in rules_hit(path), \
+            f"{rule} positive fixture produced no {rule} finding"
+
+    @pytest.mark.parametrize("rule", RULES)
+    def test_negative_fixture_is_silent(self, rule):
+        path = fixture(f"{rule.lower()}_neg.py")
+        findings = lint_file(path)
+        assert not findings, \
+            f"{rule} negative fixture is not clean: " \
+            + "; ".join(f.render() for f in findings)
+
+    def test_pr6_compile_under_lock_regression_is_red(self):
+        """The acceptance criterion: T1 demonstrably red on the PR-6
+        compile-under-engine-lock shape — and the FIXED real engine
+        (compile outside the lock) stays green."""
+        findings = [f for f in lint_file(fixture("t1_regression_pr6.py"))
+                    if f.rule == "T1"]
+        assert findings, "T1 must fire on the pre-fix engine shape"
+        assert any("lower" in f.message or "compile" in f.message
+                   for f in findings)
+        engine = os.path.join(REPO, "raft_tpu", "serving", "engine.py")
+        assert "T1" not in rules_hit(engine)
+
+    @pytest.mark.parametrize("rule", RULES)
+    def test_pragma_suppresses_each_rule(self, rule, tmp_path):
+        """Detection -> pragma round trip per rule: the positive
+        fixture with a pragma on every finding line goes silent for
+        that rule; a pragma naming a DIFFERENT rule does not."""
+        src_path = fixture(f"{rule.lower()}_pos.py")
+        findings = [f for f in lint_file(src_path) if f.rule == rule]
+        lines = open(src_path, encoding="utf-8").read().splitlines()
+        for f in findings:
+            lines[f.line - 1] += f"  # graftthread: disable={rule}"
+        # SAME basename: T3's declared lock names qualify by module
+        p = tmp_path / f"{rule.lower()}_pos.py"
+        p.write_text("\n".join(lines) + "\n")
+        assert rule not in {f.rule for f in lint_file(str(p))}
+        # a pragma for an unrelated rule must NOT suppress
+        wrong = "T1" if rule != "T1" else "T2"
+        for i, line in enumerate(lines):
+            lines[i] = line.replace(f"disable={rule}",
+                                    f"disable={wrong}")
+        p.write_text("\n".join(lines) + "\n")
+        assert rule in {f.rule for f in lint_file(str(p))}
+
+    @pytest.mark.parametrize("rule", RULES)
+    def test_baseline_roundtrip_each_rule(self, rule, tmp_path):
+        """Detection -> baseline round trip per rule: grandfathered
+        findings don't fail, a fixed finding leaves a stale entry."""
+        findings = lint_file(fixture(f"{rule.lower()}_pos.py"))
+        assert findings
+        bl = tmp_path / "baseline.json"
+        write_baseline(str(bl), findings)
+        new, stale = apply_baseline(findings, load_baseline(str(bl)))
+        assert new == [] and stale == []
+        new, stale = apply_baseline([], load_baseline(str(bl)))
+        assert new == [] and len(stale) == len(findings)
+
+
+class TestDeclarations:
+    def test_bad_declaration_is_a_finding(self, tmp_path):
+        p = tmp_path / "bad.py"
+        p.write_text("GRAFTTHREAD = {'not_a_key': ()}\n")
+        findings = lint_file(str(p))
+        assert any(f.rule == "E2" and "not_a_key" in f.message
+                   for f in findings)
+        p.write_text("LOCK_ORDER = 'oops'\n")
+        assert any(f.rule == "E2" for f in lint_file(str(p)))
+        # non-literal values must not crash the scan
+        p.write_text("GRAFTTHREAD = {'locks': make_locks()}\n")
+        assert any(f.rule == "E2" for f in lint_file(str(p)))
+
+    def test_declared_lock_and_alias(self, tmp_path):
+        """An attr that doesn't LOOK like a lock participates once
+        declared; an alias folds a Condition onto its underlying
+        lock (so the same-receiver wait exemption still applies)."""
+        p = tmp_path / "decl.py"
+        p.write_text(
+            "import time\n"
+            "GRAFTTHREAD = {'locks': ('_gate',)}\n"
+            "class S:\n"
+            "    def f(self):\n"
+            "        with self._gate:\n"
+            "            time.sleep(1)\n")
+        assert "T1" in {f.rule for f in lint_file(str(p))}
+        # without the declaration, _gate is not lockish: silent
+        p.write_text(
+            "import time\n"
+            "class S:\n"
+            "    def f(self):\n"
+            "        with self._gate:\n"
+            "            time.sleep(1)\n")
+        assert lint_file(str(p)) == []
+
+    def test_alias_resolves_wait_exemption_both_spellings(self,
+                                                          tmp_path):
+        """A Condition over a lock (aliases={'_decided': '_lock'}) is
+        the SAME lock: waiting on it is legal whichever spelling
+        acquired it — `with self._decided: self._decided.wait()` AND
+        the equally-legal `with self._lock: self._decided.wait()`."""
+        p = tmp_path / "alias.py"
+        body = ("GRAFTTHREAD = {{'locks': ('_decided',),"
+                " 'aliases': {{'_decided': '_lock'}}}}\n"
+                "class G:\n"
+                "    def f(self):\n"
+                "        with self.{held}:\n"
+                "            self._decided.wait(1.0)\n")
+        for held in ("_decided", "_lock"):
+            p.write_text(body.format(held=held))
+            assert "T1" not in {f.rule for f in lint_file(str(p))}, \
+                f"alias wait exemption failed for `with self.{held}`"
+        # a wait on an UNRELATED object under the lock still flags
+        p.write_text(
+            "class G:\n"
+            "    def f(self, ev):\n"
+            "        with self._lock:\n"
+            "            ev.wait(1.0)\n")
+        assert "T1" in {f.rule for f in lint_file(str(p))}
+
+    def test_syntax_error_is_a_finding_not_a_crash(self, tmp_path):
+        p = tmp_path / "broken.py"
+        p.write_text("def f(:\n")
+        findings = lint_file(str(p))
+        assert len(findings) == 1 and findings[0].rule == "E1"
+
+
+class TestLockGraph:
+    """T3's cycle detector over synthetic declaration graphs — no
+    files, just edges (the unit layer the ISSUE names)."""
+
+    @staticmethod
+    def edge(src, dst, path="synthetic.py", line=1, origin="declared"):
+        return {"src": src, "dst": dst, "path": path, "line": line,
+                "origin": origin}
+
+    def test_chain_is_acyclic(self):
+        edges = [self.edge("a", "b"), self.edge("b", "c"),
+                 self.edge("a", "c")]
+        assert lock_order.find_cycles(edges) == []
+
+    def test_two_cycle(self):
+        edges = [self.edge("a", "b"), self.edge("b", "a")]
+        cycles = lock_order.find_cycles(edges)
+        assert len(cycles) == 1 and set(cycles[0]) == {"a", "b"}
+
+    def test_self_loop(self):
+        assert lock_order.find_cycles([self.edge("a", "a")]) == [["a"]]
+
+    def test_long_cycle_across_modules(self):
+        """The shape T3 exists for: scheduler→breaker→metrics declared
+        order, plus one drifted inferred edge closing the loop."""
+        edges = [
+            self.edge("sched._state", "sched._cv"),
+            self.edge("sched._cv", "metrics._lock"),
+            self.edge("sched._state", "breaker._lock"),
+            self.edge("metrics._lock", "sched._state", "drift.py", 40,
+                      "inferred"),
+        ]
+        cycles = lock_order.find_cycles(edges)
+        assert len(cycles) == 1
+        assert set(cycles[0]) == {"sched._state", "sched._cv",
+                                  "metrics._lock"}
+        (finding, anchor), = lock_order.cycle_findings(edges)
+        assert finding.rule == "T3"
+        assert "inferred at drift.py:40" in finding.message
+
+    def test_disjoint_components_each_detected(self):
+        edges = [self.edge("a", "b"), self.edge("b", "a"),
+                 self.edge("x", "y"), self.edge("y", "x")]
+        assert len(lock_order.find_cycles(edges)) == 2
+
+    def test_cross_file_cycle_only_closes_in_union(self, tmp_path):
+        """Per-file scans see no cycle; the global lint_paths pass over
+        both files' edges does — the reason the driver runs T3 over
+        the UNION graph."""
+        a = tmp_path / "moda.py"
+        a.write_text("LOCK_ORDER = (('moda.one', 'modb.two'),)\n")
+        b = tmp_path / "modb.py"
+        b.write_text("LOCK_ORDER = (('modb.two', 'moda.one'),)\n")
+        assert lint_file(str(a)) == [] and lint_file(str(b)) == []
+        findings = lint_paths([str(a), str(b)])
+        assert [f.rule for f in findings] == ["T3"]
+        # pragma on the anchor CHAIN line suppresses it (cycle
+        # findings anchor at the lexicographically-first edge site)
+        a.write_text(
+            "LOCK_ORDER = (\n"
+            "    ('moda.one', 'modb.two'),"
+            "  # graftthread: disable=T3\n"
+            ")\n")
+        assert "T3" not in {f.rule
+                            for f in lint_paths([str(a), str(b)])}
+
+
+class TestMechanisms:
+    def test_pragma_inside_string_literal_does_not_suppress(
+            self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text('def f(fut):\n'
+                     '    fut.set_result(1); '
+                     's = "# graftthread: disable=all"\n')
+        assert {f.rule for f in lint_file(str(p))} == {"T2"}
+
+    def test_pragma_disable_all(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text('def f(fut):\n'
+                     '    fut.set_result(1)'
+                     '  # graftthread: disable=all (drill-only fake)\n')
+        assert lint_file(str(p)) == []
+
+    def test_stale_baseline_entry_fails_the_gate(self, tmp_path,
+                                                 capsys):
+        p = tmp_path / "legacy.py"
+        p.write_text("def f(fut):\n    fut.set_result(1)\n")
+        bl = tmp_path / "baseline.json"
+        write_baseline(str(bl), lint_file(str(p)))
+        assert main([str(p), "--baseline", str(bl),
+                     "--no-cache"]) == 0      # grandfathered
+        p.write_text("def f(fut):\n    pass\n")
+        assert main([str(p), "--baseline", str(bl),
+                     "--no-cache"]) == 1      # stale entry must burn
+        assert "stale baseline" in capsys.readouterr().err
+
+    def test_write_baseline_refuses_rule_filter(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        rc = main([fixture("t2_pos.py"), "--rules", "T1",
+                   "--write-baseline", str(bl), "--no-cache"])
+        assert rc == 2 and not bl.exists()
+
+    def test_walk_excludes_fixture_dir_but_explicit_file_wins(self):
+        walked = collect_files([os.path.join(REPO, "tests")])
+        assert not any("graftthread_fixtures" in p for p in walked)
+        explicit = collect_files([fixture("t1_pos.py")])
+        assert explicit == [fixture("t1_pos.py")]
+
+    def test_graftlint_walk_excludes_graftthread_fixtures(self):
+        """The new fixture tree is intentionally-violating code for
+        THIS tier — graftlint's walk must skip it too (t5 fixtures
+        would otherwise trip R5 on the tests/ gate path)."""
+        from tools.graftlint.core import collect_files as lint_collect
+        walked = lint_collect([os.path.join(REPO, "tests")])
+        assert not any("graftthread_fixtures" in p for p in walked)
+
+    def test_rules_filter_and_unknown_rule_errors(self, capsys):
+        rc = main([fixture("t2_pos.py"), "--rules", "T1",
+                   "--no-cache"])
+        assert rc == 0          # T2 violations invisible to a T1 run
+        rc = main([fixture("t2_pos.py"), "--rules", "T9",
+                   "--no-cache"])
+        assert rc == 2
+
+
+class TestParseCache:
+    """The shared tools/lintcache machinery under graftthread: content
+    hashed, rules-aware, invalidated by any edit to the checker
+    package — and the global T3 pass re-runs on cache HITS too."""
+
+    BAD = "def f(fut):\n    fut.set_result(1)\n"
+
+    def test_cache_replays_then_content_hash_invalidates(self,
+                                                         tmp_path):
+        p = tmp_path / "c.py"
+        p.write_text(self.BAD)
+        cache = tmp_path / "cache.json"
+        first = lint_paths([str(p)], cache_path=str(cache))
+        assert {f.rule for f in first} == {"T2"} and cache.exists()
+        # prove the second run is a HIT: doctor the stored finding
+        data = json.loads(cache.read_text())
+        (key,) = data["files"]
+        data["files"][key]["findings"][0]["message"] = "FROM-CACHE"
+        cache.write_text(json.dumps(data))
+        assert [f.message for f in
+                lint_paths([str(p)], cache_path=str(cache))] \
+            == ["FROM-CACHE"]
+        # any edit changes the content hash: the entry is dead
+        p.write_text(self.BAD + "# touched\n")
+        fresh = lint_paths([str(p)], cache_path=str(cache))
+        assert [f.message for f in fresh] != ["FROM-CACHE"]
+        assert {f.rule for f in fresh} == {"T2"}
+        assert len(json.loads(cache.read_text())["files"]) == 1
+
+    def test_cached_edges_still_feed_global_cycle_pass(self, tmp_path):
+        """A cache hit must not hide a cross-file cycle: edges are
+        cached per file, but the union cycle check runs every time."""
+        a = tmp_path / "moda.py"
+        a.write_text("LOCK_ORDER = (('moda.one', 'modb.two'),)\n")
+        b = tmp_path / "modb.py"
+        b.write_text("LOCK_ORDER = (('modb.two', 'moda.one'),)\n")
+        cache = tmp_path / "cache.json"
+        cold = lint_paths([str(a), str(b)], cache_path=str(cache))
+        warm = lint_paths([str(a), str(b)], cache_path=str(cache))
+        assert [f.rule for f in cold] == ["T3"]
+        assert [(f.rule, f.path, f.line) for f in warm] \
+            == [(f.rule, f.path, f.line) for f in cold]
+
+    def test_jobs_parallel_matches_serial(self, tmp_path):
+        files = []
+        for i, body in enumerate([self.BAD, "x = 1\n", self.BAD,
+                                  "def f(:\n"]):
+            p = tmp_path / f"f{i}.py"
+            p.write_text(body)
+            files.append(str(p))
+        assert lint_paths(files, jobs=3) == lint_paths(files)
+
+    def test_signature_invalidates_whole_cache(self, tmp_path):
+        p = tmp_path / "c.py"
+        p.write_text(self.BAD)
+        cache = tmp_path / "cache.json"
+        lint_paths([str(p)], cache_path=str(cache))
+        data = json.loads(cache.read_text())
+        data["sig"] = "some-older-graftthread"
+        (key,) = data["files"]
+        data["files"][key]["findings"][0]["message"] = "FROM-STALE"
+        cache.write_text(json.dumps(data))
+        findings = lint_paths([str(p)], cache_path=str(cache))
+        assert [f.message for f in findings] != ["FROM-STALE"]
+        assert json.loads(cache.read_text())["sig"] != \
+            "some-older-graftthread"
+
+
+class TestRepoGate:
+    """The actual gate: `python -m tools.graftthread --json` (default
+    paths + shipped baseline) clean, warm, and under budget."""
+
+    def test_repo_clean_with_empty_baseline_under_budget(self):
+        t0 = time.monotonic()
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftthread", "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        dt = time.monotonic() - t0
+        assert r.returncode == 0, \
+            f"new graftthread findings:\n{r.stdout}\n{r.stderr}"
+        assert json.loads(r.stdout) == []
+        # warm budget (the ISSUE's 30 s bound; pure-ast scan of ~15
+        # files — the margin is enormous unless something regresses
+        # into parsing the world)
+        assert dt < 30.0, f"gate took {dt:.1f}s (budget 30s)"
+
+    def test_baseline_is_empty_and_stays_empty(self):
+        """The shipped baseline starts EMPTY (graftaudit discipline):
+        every initial finding was FIXED (the settle_future migration,
+        the HangWatch join) or pragma-waived with written
+        justification at the site — never grandfathered. A baseline
+        entry appearing means someone took the shortcut this gate
+        exists to block."""
+        with open(BASELINE) as f:
+            entries = json.load(f)["findings"]
+        assert entries == [], (
+            "graftthread baseline regrew — fix or pragma the finding "
+            f"instead of grandfathering it: {entries}")
+
+    def test_default_paths_cover_the_serving_stack(self):
+        files = collect_files([os.path.join(REPO, p)
+                               for p in DEFAULT_PATHS])
+        names = {os.path.basename(p) for p in files}
+        assert {"scheduler.py", "registry.py", "resilience.py",
+                "guardian.py", "engine.py", "metrics.py",
+                "supervisor.py", "watchdog.py"} <= names
+
+    def test_real_declarations_build_the_documented_graph(self):
+        """The serving modules' LOCK_ORDER declarations load into the
+        global graph (the comment discipline, machine-readable), the
+        graph is acyclic, and one planted inversion is caught."""
+        import ast as ast_mod
+
+        from tools.graftthread.declarations import ThreadAnalysis
+        edges = []
+        for rel in ("scheduler", "registry", "guardian", "resilience",
+                    "metrics", "engine"):
+            path = os.path.join(REPO, "raft_tpu", "serving",
+                                f"{rel}.py")
+            src = open(path, encoding="utf-8").read()
+            edges += lock_order.edges(
+                ThreadAnalysis(ast_mod.parse(src), src, path))
+        srcs = {e["src"] for e in edges}
+        assert "scheduler.MicroBatchScheduler._state_lock" in srcs
+        assert "registry.ModelRegistry._lock" in srcs
+        assert "guardian.SLOGuardian._tick_lock" in srcs
+        assert lock_order.find_cycles(edges) == []
+        planted = edges + [{
+            "src": "metrics.ServingMetrics._lock",
+            "dst": "scheduler.MicroBatchScheduler._state_lock",
+            "path": "drift.py", "line": 1, "origin": "inferred"}]
+        assert lock_order.find_cycles(planted)
+
+    def test_json_mode_is_machine_readable(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftthread",
+             os.path.join("tests", "graftthread_fixtures",
+                          "t2_pos.py"),
+             "--json", "--no-cache"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 1
+        findings = json.loads(r.stdout)
+        assert findings and all(
+            set(f) >= {"path", "line", "col", "rule", "name", "message"}
+            for f in findings)
+        assert any(f["rule"] == "T2" for f in findings)

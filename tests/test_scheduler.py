@@ -1270,3 +1270,68 @@ class TestServingMetricsUnit:
                               "previous": "healthy"}
         # events without a path are a no-op, not an error
         ServingMetrics().record_event("x")
+
+
+class TestSettleFuture:
+    """raft_tpu/serving/futures.settle_future — the ONE blessed settle
+    idiom (graftthread T2). Every scheduler settle site now routes
+    through it; these units pin the contract the accounting identity
+    rides on: exactly one counted outcome per future, whoever wins the
+    race."""
+
+    def test_result_and_exception_paths(self):
+        from concurrent.futures import Future
+
+        from raft_tpu.serving.futures import settle_future
+
+        fut = Future()
+        assert settle_future(fut, 41) is True
+        assert fut.result(timeout=0) == 41
+        fut = Future()
+        assert settle_future(fut, RuntimeError("boom")) is True
+        assert isinstance(fut.exception(timeout=0), RuntimeError)
+
+    def test_exception_class_vs_instance(self):
+        """Only INSTANCES fail the future — an exception CLASS is a
+        result like any other object (callers always pass built
+        exceptions; a class slipping through would surface at
+        .result() as a confusing non-raise)."""
+        from concurrent.futures import Future
+
+        from raft_tpu.serving.futures import settle_future
+
+        fut = Future()
+        assert settle_future(fut, RuntimeError) is True
+        assert fut.result(timeout=0) is RuntimeError
+
+    def test_raced_hook_fires_exactly_on_loss(self):
+        from concurrent.futures import Future
+
+        from raft_tpu.serving.futures import settle_future
+
+        calls = []
+        fut = Future()
+        fut.set_result("winner")
+        assert settle_future(fut, "loser",
+                             raced=lambda: calls.append(1)) is False
+        assert calls == [1]
+        assert fut.result(timeout=0) == "winner"   # loser never lands
+        fut = Future()
+        assert settle_future(fut, "winner",
+                             raced=lambda: calls.append(2)) is True
+        assert calls == [1]                        # no hook on a win
+
+    def test_cancelled_future_counts_as_raced(self):
+        """The _expire-vs-cancel race shape: a caller cancel between
+        the sweep's check and the settle must be a counted outcome,
+        never an InvalidStateError killing the dispatcher."""
+        from concurrent.futures import Future
+
+        from raft_tpu.serving.futures import settle_future
+
+        fut = Future()
+        assert fut.cancel()
+        raced = []
+        assert settle_future(fut, DeadlineExceeded("late"),
+                             raced=lambda: raced.append(1)) is False
+        assert raced == [1]

@@ -26,50 +26,24 @@ import argparse
 import ast
 import json
 import os
-import re
 import sys
 from collections import Counter
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+try:
+    from tools import lintcache
+except ImportError:          # invoked as a top-level package (tests
+    import lintcache         # insert the repo root on sys.path)
+
 from .finding import Finding
 from .jitctx import Analysis
 
-#: directory basenames never entered when walking a directory argument
-#: (graftaudit_fixtures: graftaudit's intentionally-violating audit
-#: fixtures, the artifact-tier analog of graftlint_fixtures)
-_EXCLUDED_DIRS = {"__pycache__", ".git", "graftlint_fixtures",
-                  "graftaudit_fixtures", "node_modules", ".venv"}
-
-# rule list only — a trailing bare-word justification ("disable=R5
-# process-lifetime by design") must not be swallowed into the rule id
-_PRAGMA_RE = re.compile(
-    r"#\s*graftlint:\s*disable="
-    r"(all|[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
-
 
 def collect_files(paths: Sequence[str]) -> List[str]:
-    """Expand dir args to ``**/*.py`` (minus excluded dirs); keep
+    """Expand dir args to ``**/*.py`` (minus the shared excluded dirs:
+    the intentionally-violating *_fixtures trees, caches); keep
     explicit file args verbatim (even non-.py: caller's choice)."""
-    out: List[str] = []
-    seen = set()
-
-    def add(path: str) -> None:
-        key = os.path.normpath(path)
-        if key not in seen:   # a file named explicitly AND reached by a
-            seen.add(key)     # dir walk must lint once, not twice
-            out.append(path)
-
-    for p in paths:
-        if os.path.isdir(p):
-            for root, dirs, files in os.walk(p):
-                dirs[:] = sorted(d for d in dirs
-                                 if d not in _EXCLUDED_DIRS)
-                for f in sorted(files):
-                    if f.endswith(".py"):
-                        add(os.path.join(root, f))
-        else:
-            add(p)
-    return out
+    return lintcache.collect_files(paths)
 
 
 def parse_pragmas(source: str) -> Dict[int, Optional[set]]:
@@ -78,29 +52,7 @@ def parse_pragmas(source: str) -> Dict[int, Optional[set]]:
     Tokenized, not regexed over raw lines: the pragma must live in an
     actual COMMENT token — a string literal that merely CONTAINS
     "graftlint: disable=..." must not suppress findings on its line."""
-    import io
-    import tokenize
-
-    pragmas: Dict[int, Optional[set]] = {}
-    try:
-        tokens = list(tokenize.generate_tokens(
-            io.StringIO(source).readline))
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        return pragmas   # unparsable files already yield E1 findings
-    for tok in tokens:
-        if tok.type != tokenize.COMMENT:
-            continue
-        m = _PRAGMA_RE.search(tok.string)
-        if not m:
-            continue
-        spec = m.group(1).strip()
-        line = tok.start[0]
-        if spec.lower() == "all":
-            pragmas[line] = None
-        else:
-            pragmas[line] = {r.strip().upper() for r in spec.split(",")
-                             if r.strip()}
-    return pragmas
+    return lintcache.parse_pragmas(source, "graftlint")
 
 
 def lint_file(path: str, rules=None) -> List[Finding]:
@@ -131,62 +83,21 @@ def lint_file(path: str, rules=None) -> List[Finding]:
     return sorted(kept, key=lambda f: (f.line, f.col, f.rule))
 
 
-# -- parse cache + parallel walk ------------------------------------------
-
-_SIG_CACHE: List[str] = []
-
+# -- parse cache + parallel walk (tools/lintcache machinery) --------------
 
 def _rules_signature() -> str:
-    """Content hash of the whole graftlint package: editing any rule
-    (or this driver) invalidates every cache entry — a cache must never
+    """Content hash of the whole graftlint package PLUS the shared
+    lintcache module: editing any rule, this driver, or the cache
+    machinery itself invalidates every cache entry — a cache must never
     outlive the code that produced it."""
-    if not _SIG_CACHE:
-        import hashlib
-
-        h = hashlib.sha256()
-        pkg = os.path.dirname(os.path.abspath(__file__))
-        for root, dirs, files in os.walk(pkg):
-            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
-            for f in sorted(files):
-                if f.endswith(".py"):
-                    with open(os.path.join(root, f), "rb") as fh:
-                        h.update(f.encode() + b"\0" + fh.read())
-        _SIG_CACHE.append(h.hexdigest()[:16])
-    return _SIG_CACHE[0]
+    return lintcache.package_signature(
+        os.path.dirname(os.path.abspath(__file__)),
+        lintcache.__file__)
 
 
 def default_cache_path() -> str:
-    root = os.environ.get("RAFT_GRAFTLINT_CACHE")
-    if root:
-        return root
-    home = os.path.expanduser("~")
-    base = (os.path.join(home, ".cache") if home != "~"
-            else os.path.join(os.sep, "tmp"))
-    return os.path.join(base, "raft_tpu", "graftlint_cache.json")
-
-
-def _load_cache(path: str) -> Dict:
-    try:
-        with open(path, encoding="utf-8") as f:
-            data = json.load(f)
-        if data.get("sig") == _rules_signature():
-            return data
-    except (OSError, ValueError):
-        pass
-    return {"sig": _rules_signature(), "files": {}}
-
-
-def _save_cache(path: str, cache: Dict) -> None:
-    """Atomic, last-writer-wins: concurrent gate runs (pytest spawns
-    several) may each write; any complete file is a valid cache."""
-    try:
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(cache, f)
-        os.replace(tmp, path)
-    except OSError:
-        pass     # a cache is an accelerator, never a correctness gate
+    return lintcache.default_cache_path("RAFT_GRAFTLINT_CACHE",
+                                        "graftlint_cache.json")
 
 
 def _rule_ids(rules) -> Optional[List[str]]:
@@ -211,30 +122,23 @@ def lint_paths(paths: Sequence[str], rules=None,
     the file bytes, active rule ids) under the package-wide rules
     signature, so an edit to a file, a rule filter, or the linter
     itself can never replay stale findings."""
-    import hashlib
-
     files = collect_files(paths)
     findings_by_file: Dict[str, List[Finding]] = {}
     misses: List[str] = []
     cache = hashes = None
     ids = _rule_ids(rules)
+    rkey = ",".join(ids) if ids is not None else "*"
     if cache_path:
-        cache = _load_cache(cache_path)
+        cache = lintcache.load_cache(cache_path, _rules_signature())
         hashes = {}
-        rkey = ",".join(ids) if ids is not None else "*"
         for path in files:
-            try:
-                with open(path, "rb") as f:
-                    digest = hashlib.sha256(f.read()).hexdigest()
-            except OSError:
+            digest = lintcache.file_digest(path)
+            if digest is None:
                 misses.append(path)   # unreadable: E0 via lint_file
                 continue
             hashes[path] = digest
-            # ABSOLUTE key paths: the default cache is user-global, so
-            # cwd-relative keys from two working directories would
-            # collide and evict each other
             entry = cache["files"].get(
-                f"{os.path.abspath(path)}|{digest}|{rkey}")
+                lintcache.cache_key(path, digest, rkey))
             if entry is None:
                 misses.append(path)
             else:
@@ -243,41 +147,24 @@ def lint_paths(paths: Sequence[str], rules=None,
         misses = list(files)
 
     if jobs > 1 and len(misses) > 1:
-        import multiprocessing
-
-        with multiprocessing.Pool(min(jobs, len(misses))) as pool:
-            linted = pool.map(_lint_one, [(p, ids) for p in misses])
+        linted = lintcache.map_jobs(_lint_one,
+                                    [(p, ids) for p in misses], jobs)
     else:
+        # serial path uses the caller's actual rule MODULES — a custom
+        # rule object outside ALL_RULES must run, not silently resolve
+        # to nothing through the id round-trip the pool needs
         linted = [lint_file(p, rules=rules) for p in misses]
     for path, fs in zip(misses, linted):
         findings_by_file[path] = fs
 
     if cache is not None:
-        rkey = ",".join(ids) if ids is not None else "*"
         for path, fs in zip(misses, linted):
             digest = hashes.get(path)
             if digest is not None:
-                cache["files"][
-                    f"{os.path.abspath(path)}|{digest}|{rkey}"
-                ] = [f.__dict__ for f in fs]
-        # evict dead weight — without this the shared user-level file
-        # grows forever: entries for a file seen this run under a
-        # superseded digest (any rule filter), and entries whose file
-        # no longer exists at all (deleted/renamed paths; keys are
-        # absolute, so the exists() check is cwd-independent)
-        current = {os.path.abspath(p): d for p, d in hashes.items()}
-        alive: Dict[str, bool] = {}
-        for key in list(cache["files"]):
-            path, digest = key.split("|", 2)[:2]
-            if path in current:
-                if digest != current[path]:
-                    del cache["files"][key]
-            else:
-                if path not in alive:
-                    alive[path] = os.path.exists(path)
-                if not alive[path]:
-                    del cache["files"][key]
-        _save_cache(cache_path, cache)
+                cache["files"][lintcache.cache_key(path, digest, rkey)] \
+                    = [f.__dict__ for f in fs]
+        lintcache.evict_dead_entries(cache, hashes)
+        lintcache.save_cache(cache_path, cache)
 
     out: List[Finding] = []
     for path in files:
@@ -285,90 +172,29 @@ def lint_paths(paths: Sequence[str], rules=None,
     return out
 
 
-# -- baseline -------------------------------------------------------------
-
-# keyed on (mtime, size) so library users that lint across edits (a
-# pytest process, an editor integration) never key a baseline entry
-# off stale content
-_LINES_CACHE: Dict[str, Tuple[Tuple[float, int], List[str]]] = {}
-
-
-def _code_line(finding: Finding) -> str:
-    try:
-        st = os.stat(finding.path)
-        stamp = (st.st_mtime, st.st_size)
-    except OSError:
-        return ""
-    cached = _LINES_CACHE.get(finding.path)
-    if cached is None or cached[0] != stamp:
-        try:
-            with open(finding.path, encoding="utf-8") as f:
-                lines = f.read().splitlines()
-        except OSError:
-            lines = []
-        _LINES_CACHE[finding.path] = (stamp, lines)
-    else:
-        lines = cached[1]
-    if 1 <= finding.line <= len(lines):
-        return lines[finding.line - 1].strip()
-    return ""
-
+# -- baseline (tools/lintcache machinery) ---------------------------------
 
 def finding_key(finding: Finding) -> Tuple[str, str, str]:
-    return finding.key(_code_line(finding))
+    return finding.key(lintcache.code_line(finding.path, finding.line))
 
 
 def load_baseline(path: str) -> Counter:
-    with open(path, encoding="utf-8") as f:
-        data = json.load(f)
-    return Counter(
-        (e["path"].replace("\\", "/"), e["rule"], e["code"])
-        for e in data.get("findings", []))
+    return lintcache.load_baseline(path)
 
 
 def write_baseline(path: str, findings: Iterable[Finding]) -> None:
-    entries = [{"path": k[0], "rule": k[1], "code": k[2]}
-               for k in sorted(finding_key(f) for f in findings)]
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump({
-            "comment": "graftlint grandfathered findings — burn down, "
-                       "never grow; regenerate with --write-baseline "
-                       "after fixing one",
-            "findings": entries,
-        }, f, indent=2, sort_keys=True)
-        f.write("\n")
+    lintcache.write_baseline(path, (finding_key(f) for f in findings),
+                             "graftlint")
 
 
 def apply_baseline(findings: List[Finding], baseline: Counter,
                    linted_paths: Optional[Iterable[str]] = None,
                    ) -> Tuple[List[Finding], List[Tuple[str, str, str]]]:
-    """Returns (new findings, stale baseline keys).
-
-    Stale entries are NOT a free pass: an unconsumed entry would
-    silently grandfather the next reintroduction of that exact line,
-    so the CLI fails on them and demands a regenerate (the baseline
-    must only ever shrink, and shrink EXPLICITLY). An entry whose file
-    was not in ``linted_paths`` at all (a partial run) is merely
-    unchecked, not stale; ``linted_paths=None`` treats every
-    unconsumed entry as stale."""
-    remaining = Counter(baseline)
-    new: List[Finding] = []
-    for f in findings:
-        k = finding_key(f)
-        if remaining.get(k, 0) > 0:
-            remaining[k] -= 1
-        else:
-            new.append(f)
-    if linted_paths is not None:
-        linted = {os.path.normpath(p).replace("\\", "/")
-                  for p in linted_paths}
-        checked = (lambda k: os.path.normpath(k[0]).replace("\\", "/")
-                   in linted)
-    else:
-        checked = (lambda k: True)
-    stale = sorted(k for k, n in remaining.items() if checked(k)
-                   for _ in range(n))
-    return new, stale
+    """Returns (new findings, stale baseline keys) — see
+    :func:`tools.lintcache.apply_baseline` for the shrink-only
+    discipline this enforces."""
+    return lintcache.apply_baseline(findings, baseline, finding_key,
+                                    linted_paths=linted_paths)
 
 
 # -- CLI ------------------------------------------------------------------

@@ -1,0 +1,33 @@
+"""graftthread — thread-safety static analysis for the serving stack.
+
+The third analysis tier beside graftlint (source invariants) and
+graftaudit (compiled artifacts): pure-stdlib ``ast`` over the
+multi-threaded serving modules plus a lightweight declaration
+convention (``LOCK_ORDER`` / ``GRAFTTHREAD`` module constants — see
+tools/graftthread/declarations.py). Six rules, each the mechanized
+form of a concurrency bug PRs 6-10 caught by hand:
+
+- T1 blocking-call-under-lock     — XLA compiles, Future waits, sleeps
+                                    inside a ``with <lock>`` body
+- T2 unguarded-future-settle      — raw set_result/set_exception
+                                    instead of serving.futures.
+                                    settle_future
+- T3 lock-order-cycle             — cycles in the declared + inferred
+                                    lock acquisition graph
+- T4 callback-under-lock          — declared listeners fired while a
+                                    lock is held
+- T5 thread-lifecycle             — threads not daemon-flagged, or
+                                    never joined/quarantine-accounted
+- T6 consequences-before-futures  — verdict fns settling futures
+                                    before their consequences land
+
+Run ``python -m tools.graftthread --help`` from the repo root; the
+tier-1 gate is ``tests/test_graftthread.py``.
+"""
+
+from .core import (DEFAULT_PATHS, apply_baseline, lint_file, lint_paths,
+                   load_baseline, main, write_baseline)
+from .finding import Finding
+
+__all__ = ["Finding", "DEFAULT_PATHS", "apply_baseline", "lint_file",
+           "lint_paths", "load_baseline", "main", "write_baseline"]

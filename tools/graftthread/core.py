@@ -1,0 +1,400 @@
+"""graftthread driver: walk files, run rules, global lock graph, CLI.
+
+Usage (from the repo root; the argument-less form is the tier-1
+gate)::
+
+    python -m tools.graftthread --json
+    python -m tools.graftthread raft_tpu/serving some_file.py \
+        --baseline tools/graftthread/baseline.json
+
+With no paths the scan covers :data:`DEFAULT_PATHS` — the
+multi-threaded serving stack, the training supervisor, and the shared
+utils — the tree whose concurrency invariants T1-T6 encode. Exit
+codes: 0 clean (modulo baseline), 1 new findings, 2 usage/parse error.
+``--json`` prints a machine-readable findings list; ``--write-baseline``
+regenerates the grandfather file (shrink-only discipline, as in
+graftlint/graftaudit — the shipped baseline is EMPTY and must stay
+that way: findings are fixed or pragma-waived with justification,
+never silently baselined).
+
+Suppression: ``# graftthread: disable=T1,T5   (justification)`` on the
+finding's anchor line. T3 cycle findings anchor at the cycle's
+lexicographically-first edge site (a ``LOCK_ORDER`` chain line or an
+inferred nested-``with`` line).
+
+Two passes per run: the per-file rules (T1/T2/T4/T5/T6, plus T3 over a
+*single* file's edges in ``lint_file``), then — in ``lint_paths`` —
+the GLOBAL T3 pass over the union of every file's declared + inferred
+acquisition edges, where cross-module cycles (scheduler→breaker→
+metrics, registry→scheduler) actually close. The content-hash parse
+cache (tools/lintcache, shared with graftlint) stores each file's
+findings, edges, and pragma lines; the global graph pass re-runs every
+time (it is a dict walk, not a parse) so a cache hit can never hide a
+cross-file cycle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+try:
+    from tools import lintcache
+except ImportError:          # invoked as a top-level package (tests
+    import lintcache         # insert the repo root on sys.path)
+
+from .declarations import ThreadAnalysis
+from .finding import Finding
+
+#: the argument-less scan: the multi-threaded serving stack, the
+#: process supervisor, and the shared utils (watchdog's poll thread,
+#: retry, timing) — relative to the repo root the gate runs from
+DEFAULT_PATHS = ("raft_tpu/serving",
+                 os.path.join("raft_tpu", "training", "supervisor.py"),
+                 "raft_tpu/utils")
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    return lintcache.collect_files(paths)
+
+
+def parse_pragmas(source: str) -> Dict[int, Optional[set]]:
+    return lintcache.parse_pragmas(source, "graftthread")
+
+
+def _apply_pragmas(findings: List[Finding],
+                   pragmas: Dict[int, Optional[set]]) -> List[Finding]:
+    kept = []
+    for f in findings:
+        disabled = pragmas.get(f.line)
+        if f.line in pragmas and (disabled is None or f.rule in disabled):
+            continue
+        kept.append(f)
+    return sorted(kept, key=lambda f: (f.line, f.col, f.rule))
+
+
+def scan_file(path: str, rules=None) -> Dict:
+    """One file's full scan: ``{"findings": [per-file findings, pragma-
+    filtered], "edges": [lock-graph edges], "pragmas": {line: rules}}``.
+    T3 runs over the file's own edges ONLY in :func:`lint_file`; here
+    the edges are returned raw for the driver's global pass."""
+    from .rules import ALL_RULES, lock_order
+    rules = ALL_RULES if rules is None else rules
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except OSError as exc:
+        return {"findings": [Finding(path, 0, 0, "E0", "unreadable",
+                                     str(exc))],
+                "edges": [], "pragmas": {}}
+    try:
+        analysis = ThreadAnalysis(ast.parse(source, filename=path),
+                                  source, path)
+    except SyntaxError as exc:
+        return {"findings": [Finding(path, exc.lineno or 0,
+                                     exc.offset or 0, "E1",
+                                     "syntax-error",
+                                     exc.msg or "syntax error")],
+                "edges": [], "pragmas": {}}
+    pragmas = parse_pragmas(source)
+    findings: List[Finding] = [
+        Finding(path, line, col, "E2", "bad-declaration", msg)
+        for line, col, msg in analysis.decl_errors]
+    for mod in rules:
+        if mod is lock_order:
+            continue          # global pass; lint_file adds it per-file
+        findings.extend(mod.check(analysis))
+    active_edges = (lock_order.edges(analysis)
+                    if lock_order in rules else [])
+    return {"findings": _apply_pragmas(findings, pragmas),
+            "edges": active_edges, "pragmas": pragmas}
+
+
+def lint_file(path: str, rules=None) -> List[Finding]:
+    """All findings for ONE file — per-file rules plus T3 over the
+    file's own edge set (the fixture/unit mode; the repo gate's T3 is
+    global, via :func:`lint_paths`)."""
+    from .rules import ALL_RULES, lock_order
+    rules = ALL_RULES if rules is None else rules
+    entry = scan_file(path, rules)
+    findings = list(entry["findings"])
+    if lock_order in rules and entry["edges"]:
+        cyc = [f for f, _ in lock_order.cycle_findings(entry["edges"])]
+        findings.extend(_apply_pragmas(cyc, entry["pragmas"]))
+    return sorted(findings, key=lambda f: (f.line, f.col, f.rule))
+
+
+# -- parse cache + parallel walk (tools/lintcache machinery) --------------
+
+def _rules_signature() -> str:
+    """Content hash of the graftthread package PLUS the shared
+    lintcache module — a cache must never outlive the code that
+    produced it."""
+    return lintcache.package_signature(
+        os.path.dirname(os.path.abspath(__file__)),
+        lintcache.__file__)
+
+
+def default_cache_path() -> str:
+    return lintcache.default_cache_path("RAFT_GRAFTTHREAD_CACHE",
+                                        "graftthread_cache.json")
+
+
+def _rule_ids(rules) -> Optional[List[str]]:
+    return None if rules is None else sorted(m.RULE for m in rules)
+
+
+def _rules_from_ids(ids: Optional[List[str]]):
+    if ids is None:
+        return None
+    from .rules import ALL_RULES
+    return [m for m in ALL_RULES if m.RULE in set(ids)]
+
+
+def _entry_to_json(entry: Dict) -> Dict:
+    return {"findings": [f.__dict__ for f in entry["findings"]],
+            "edges": entry["edges"],
+            "pragmas": {str(k): (sorted(v) if v is not None else None)
+                        for k, v in entry["pragmas"].items()}}
+
+
+def _entry_from_json(data: Dict) -> Dict:
+    return {"findings": [Finding(**d) for d in data["findings"]],
+            "edges": data["edges"],
+            "pragmas": {int(k): (set(v) if v is not None else None)
+                        for k, v in data["pragmas"].items()}}
+
+
+def _scan_one(job: Tuple[str, Optional[List[str]]]) -> Dict:
+    """Pool worker: rule MODULES don't pickle, ids do."""
+    path, ids = job
+    return scan_file(path, rules=_rules_from_ids(ids))
+
+
+def lint_paths(paths: Sequence[str], rules=None,
+               cache_path: Optional[str] = None,
+               jobs: int = 1) -> List[Finding]:
+    """Scan, optionally with the shared content-hash parse cache and a
+    process pool over cache misses (cache entries key on file hash +
+    active rule ids under the package signature — identical discipline
+    to graftlint's). Per-file findings come first in path order, then
+    the global T3 cycle findings."""
+    from .rules import lock_order
+    files = collect_files(paths)
+    entries: Dict[str, Dict] = {}
+    misses: List[str] = []
+    cache = hashes = None
+    ids = _rule_ids(rules)
+    rkey = ",".join(ids) if ids is not None else "*"
+    if cache_path:
+        cache = lintcache.load_cache(cache_path, _rules_signature())
+        hashes = {}
+        for path in files:
+            digest = lintcache.file_digest(path)
+            if digest is None:
+                misses.append(path)   # unreadable: E0 via scan_file
+                continue
+            hashes[path] = digest
+            stored = cache["files"].get(
+                lintcache.cache_key(path, digest, rkey))
+            if stored is None:
+                misses.append(path)
+            else:
+                entries[path] = _entry_from_json(stored)
+    else:
+        misses = list(files)
+
+    if jobs > 1 and len(misses) > 1:
+        scanned = lintcache.map_jobs(_scan_one,
+                                     [(p, ids) for p in misses], jobs)
+    else:
+        # serial path uses the caller's actual rule MODULES — a custom
+        # rule object outside ALL_RULES must run, not silently resolve
+        # to nothing through the id round-trip the pool needs
+        scanned = [scan_file(p, rules=rules) for p in misses]
+    for path, entry in zip(misses, scanned):
+        entries[path] = entry
+
+    if cache is not None:
+        for path, entry in zip(misses, scanned):
+            digest = hashes.get(path)
+            if digest is not None:
+                cache["files"][lintcache.cache_key(path, digest, rkey)] \
+                    = _entry_to_json(entry)
+        lintcache.evict_dead_entries(cache, hashes)
+        lintcache.save_cache(cache_path, cache)
+
+    out: List[Finding] = []
+    for path in files:
+        out.extend(entries.get(path, {}).get("findings", []))
+
+    # the global T3 pass: union every file's edges, re-run the cycle
+    # check (cheap — no parsing), pragma-filter each cycle finding
+    # against its ANCHOR file's pragma lines
+    if rules is None or any(m is lock_order for m in rules):
+        all_edges = [e for path in files
+                     for e in entries.get(path, {}).get("edges", [])]
+        for finding, _anchor in lock_order.cycle_findings(all_edges):
+            pragmas = entries.get(finding.path, {}).get("pragmas", {})
+            if _apply_pragmas([finding], pragmas):
+                out.append(finding)
+    return out
+
+
+# -- baseline (tools/lintcache machinery) ---------------------------------
+
+def finding_key(finding: Finding) -> Tuple[str, str, str]:
+    return finding.key(lintcache.code_line(finding.path, finding.line))
+
+
+def load_baseline(path: str) -> Counter:
+    return lintcache.load_baseline(path)
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    lintcache.write_baseline(path, (finding_key(f) for f in findings),
+                             "graftthread")
+
+
+def apply_baseline(findings: List[Finding], baseline: Counter,
+                   linted_paths: Optional[Iterable[str]] = None,
+                   ) -> Tuple[List[Finding], List[Tuple[str, str, str]]]:
+    """Returns (new findings, stale baseline keys) — the shrink-only
+    discipline of :func:`tools.lintcache.apply_baseline`."""
+    return lintcache.apply_baseline(findings, baseline, finding_key,
+                                    linted_paths=linted_paths)
+
+
+# -- CLI ------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftthread",
+        description="Thread-safety static analysis for the serving "
+                    "stack (rules T1-T6; see tools/graftthread/"
+                    "rules/). With no paths, scans the serving stack "
+                    "+ supervisor + utils against the shipped "
+                    "baseline.")
+    p.add_argument("paths", nargs="*",
+                   help="files and/or directories to check (default: "
+                        f"{' '.join(DEFAULT_PATHS)}, with the shipped "
+                        "baseline applied)")
+    p.add_argument("--baseline", metavar="JSON",
+                   help="grandfather file: matching findings don't "
+                        "fail the run (burn-down workflow)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (list of findings)")
+    p.add_argument("--write-baseline", metavar="JSON",
+                   help="write current findings as the new baseline "
+                        "and exit 0")
+    p.add_argument("--rules", metavar="T1,T3,...",
+                   help="run only these rule ids")
+    p.add_argument("--jobs", type=int, default=1, metavar="N",
+                   help="scan cache misses across N processes "
+                        "(default 1: in-process)")
+    p.add_argument("--cache", metavar="JSON", default=None,
+                   help="parse-cache file (default: "
+                        "$RAFT_GRAFTTHREAD_CACHE or "
+                        "~/.cache/raft_tpu/graftthread_cache.json); "
+                        "same content-hash + package-signature "
+                        "discipline as graftlint's cache")
+    p.add_argument("--no-cache", action="store_true",
+                   help="scan every file from scratch")
+    args = p.parse_args(argv)
+
+    if args.jobs < 1:
+        print("graftthread: --jobs must be >= 1", file=sys.stderr)
+        return 2
+    cache_path = None if args.no_cache \
+        else (args.cache or default_cache_path())
+
+    paths = list(args.paths)
+    baseline_path = args.baseline
+    if not paths:
+        paths = list(DEFAULT_PATHS)
+        if baseline_path is None and not args.write_baseline:
+            # the argument-less gate applies the shipped baseline, so
+            # `python -m tools.graftthread --json` IS the tier-1 gate
+            baseline_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "baseline.json")
+
+    rules = None
+    if args.rules:
+        from .rules import ALL_RULES
+        want = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [m for m in ALL_RULES if m.RULE in want]
+        unknown = want - {m.RULE for m in rules}
+        if unknown:
+            print(f"graftthread: unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    if args.write_baseline and args.rules:
+        # a rule-filtered regenerate would silently drop every other
+        # rule's grandfathered entries and fail the next full gate run
+        print("graftthread: refusing --write-baseline with --rules — "
+              "regenerate from a full-rule run over the gate's paths",
+              file=sys.stderr)
+        return 2
+
+    findings = lint_paths(paths, rules=rules,
+                          cache_path=cache_path, jobs=args.jobs)
+    hard_errors = [f for f in findings if f.rule.startswith("E")]
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline,
+                       [f for f in findings
+                        if not f.rule.startswith("E")])
+        print(f"graftthread: wrote {len(findings) - len(hard_errors)} "
+              f"finding(s) to {args.write_baseline} — remember the "
+              "discipline: the SHIPPED baseline stays EMPTY (fix or "
+              "pragma-with-justification instead)", file=sys.stderr)
+        return 0
+
+    stale: List[Tuple[str, str, str]] = []
+    if baseline_path:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"graftthread: unreadable baseline "
+                  f"{baseline_path}: {exc}", file=sys.stderr)
+            return 2
+        if rules is not None:
+            active = {m.RULE for m in rules}
+            baseline = Counter({k: v for k, v in baseline.items()
+                                if k[1] in active})
+        findings, stale = apply_baseline(
+            findings, baseline, linted_paths=collect_files(paths))
+
+    if args.as_json:
+        # stale entries ride in the same list (rule B0) so a machine
+        # consumer sees WHY the run failed, not `[]` with rc=1
+        print(json.dumps([{
+            "path": f.path, "line": f.line, "col": f.col,
+            "rule": f.rule, "name": f.name, "message": f.message,
+        } for f in findings] + [{
+            "path": k[0], "line": 0, "col": 0, "rule": "B0",
+            "name": "stale-baseline",
+            "message": f"stale baseline entry for {k[1]}: {k[2]!r} — "
+                       "regenerate with --write-baseline",
+        } for k in stale], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"graftthread: {len(findings)} new finding(s)",
+                  file=sys.stderr)
+    if stale:
+        for k in stale:
+            print(f"graftthread: stale baseline entry {k[0]} [{k[1]}] "
+                  f"{k[2]!r}", file=sys.stderr)
+        print(f"graftthread: {len(stale)} stale baseline entr(y/ies) — "
+              "regenerate with --write-baseline so it cannot "
+              "grandfather a future reintroduction", file=sys.stderr)
+    return 1 if (findings or stale) else 0

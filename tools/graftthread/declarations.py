@@ -1,0 +1,268 @@
+"""Shared per-file analysis for graftthread rules: locks + declarations.
+
+Thread-safety facts a static checker cannot infer reliably — which
+attributes are locks when their names don't say so, the intended
+cross-module lock acquisition order, which attributes hold
+caller-supplied callbacks, which functions are wedge/rollback verdicts
+and which calls are their "consequences" — ride a **lightweight
+declaration convention** in the checked modules themselves. Two
+module-level constants, both plain literals (parsed with
+``ast.literal_eval``, zero runtime cost, greppable):
+
+``LOCK_ORDER``
+    A tuple of acquisition *chains* — each chain a tuple of qualified
+    lock names (``"module.Class.attr"``; a bare name is qualified with
+    the declaring module). Consecutive names form allowed
+    before→after edges; T3 unions these with the *inferred* edges from
+    lexically nested ``with <lock>:`` statements across every scanned
+    file and fails on any cycle. A single-name chain just registers a
+    leaf lock (nothing may be declared or inferred to nest under it in
+    the reverse direction).
+
+``GRAFTTHREAD``
+    A dict of rule inputs (all keys optional)::
+
+        GRAFTTHREAD = {
+            "locks": ("_decided",),       # attrs that ARE locks despite
+                                          #   the name (Condition etc.)
+            "aliases": {"_decided": "_lock"},  # same underlying lock
+            "callbacks": ("on_transition",),   # T4: caller-supplied
+                                          #   listeners — never call
+                                          #   them under a lock
+            "verdicts": ("_wedge_verdict",),   # T6: verdict functions
+            "consequences": ("drop_bucket",),  # T6: must precede settles
+            "settles": ("_fail_requests",),    # T6 ONLY: extra calls
+                                          #   that COUNT as settles for
+                                          #   verdict ordering (T2
+                                          #   stays strict: raw settles
+                                          #   belong in settle_future
+                                          #   alone)
+            "settle_helper": True,        # T2: this module DEFINES the
+                                          #   one blessed settle idiom
+        }
+
+Everything else here is the per-file AST plumbing the rule modules
+share (parents map, scope walk, lock-``with`` discovery). Pure stdlib
+``ast`` — graftthread must check files that import jax without
+importing jax itself.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+#: attr-name heuristic: these read as locks without a declaration
+_LOCKISH_RE = re.compile(r"lock|mutex|_cv$|^cv$|cond|semaphore", re.I)
+
+#: declaration keys and their defaults (unknown keys are an E2 finding
+#: — a typo'd key would silently disable the rule it feeds)
+DECL_DEFAULTS = {
+    "locks": (),
+    "aliases": {},
+    "callbacks": (),
+    "verdicts": (),
+    "consequences": (),
+    "settles": (),
+    "settle_helper": False,
+}
+
+#: settle wrappers blessed everywhere (the raft_tpu.serving.futures
+#: helper); module declarations extend per file
+BASE_SETTLES = ("settle_future",)
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` -> "a.b.c"; None for anything not a plain name chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_same_scope(nodes) -> Iterator[ast.AST]:
+    """Walk ``nodes`` (a list of statements or one node) without
+    descending into nested function/lambda bodies — a lock held at the
+    ``with`` is NOT held when a closure defined inside it runs later."""
+    todo = list(nodes) if isinstance(nodes, list) else [nodes]
+    while todo:
+        node = todo.pop()
+        yield node
+        if isinstance(node, _SCOPES):
+            continue        # never descend INTO a nested scope body
+        todo.extend(ast.iter_child_nodes(node))
+
+
+class LockWith:
+    """One ``with <lock>:`` acquisition site."""
+
+    __slots__ = ("node", "expr", "expr_dotted", "segment", "qualified")
+
+    def __init__(self, node: ast.With, expr: ast.AST, expr_dotted: str,
+                 segment: str, qualified: str):
+        self.node = node                  # the With statement
+        self.expr = expr                  # the lock expression node
+        self.expr_dotted = expr_dotted    # e.g. "self._cv"
+        self.segment = segment            # e.g. "_cv" (alias-resolved)
+        self.qualified = qualified        # e.g. "scheduler.MicroBatchScheduler._cv"
+
+
+class ThreadAnalysis:
+    """One-pass per-file analysis shared by all graftthread rules."""
+
+    def __init__(self, tree: ast.Module, source: str, path: str):
+        self.tree = tree
+        self.source = source
+        self.path = path
+        self.modname = os.path.splitext(os.path.basename(path))[0]
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.decl_errors: List[Tuple[int, int, str]] = []
+        self.decl = dict(DECL_DEFAULTS)
+        #: list of (chain names, lineno) from LOCK_ORDER
+        self.lock_order: List[Tuple[List[str], int]] = []
+        self._parse_declarations()
+        self.settles = set(BASE_SETTLES) | set(self.decl["settles"])
+        #: every ``with <lock>:`` site in the file
+        self.lock_withs: List[LockWith] = []
+        self._collect_lock_withs()
+
+    # -- declarations -----------------------------------------------------
+
+    def _parse_declarations(self) -> None:
+        for node in self.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            if tgt.id == "GRAFTTHREAD":
+                self._parse_decl_dict(node)
+            elif tgt.id == "LOCK_ORDER":
+                self._parse_lock_order(node)
+
+    def _err(self, node: ast.AST, msg: str) -> None:
+        self.decl_errors.append((node.lineno, node.col_offset, msg))
+
+    def _parse_decl_dict(self, node: ast.Assign) -> None:
+        try:
+            val = ast.literal_eval(node.value)
+        except ValueError:
+            self._err(node, "GRAFTTHREAD must be a literal dict "
+                            "(strings/tuples only)")
+            return
+        if not isinstance(val, dict):
+            self._err(node, "GRAFTTHREAD must be a dict")
+            return
+        for key, v in val.items():
+            if key not in DECL_DEFAULTS:
+                self._err(node, f"unknown GRAFTTHREAD key {key!r} "
+                                f"(valid: {sorted(DECL_DEFAULTS)})")
+                continue
+            self.decl[key] = v
+
+    def _parse_lock_order(self, node: ast.Assign) -> None:
+        value = node.value
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            self._err(node, "LOCK_ORDER must be a literal tuple of "
+                            "chains")
+            return
+        for chain_node in value.elts:
+            if isinstance(chain_node, (ast.Tuple, ast.List)):
+                try:
+                    names = [str(x) for x in
+                             ast.literal_eval(chain_node)]
+                except ValueError:
+                    self._err(chain_node, "LOCK_ORDER chain must hold "
+                                          "string lock names")
+                    continue
+            elif (isinstance(chain_node, ast.Constant)
+                    and isinstance(chain_node.value, str)):
+                names = [chain_node.value]
+            else:
+                self._err(chain_node, "LOCK_ORDER chain must be a "
+                                      "tuple of string lock names")
+                continue
+            self.lock_order.append(
+                ([self.qualify_name(n) for n in names],
+                 chain_node.lineno))
+
+    def qualify_name(self, name: str) -> str:
+        """A declared lock name with no module prefix belongs to the
+        declaring module."""
+        return name if "." in name else f"{self.modname}.{name}"
+
+    # -- locks ------------------------------------------------------------
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_function(self, node: ast.AST
+                           ) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, _SCOPES):
+            cur = self.parents.get(cur)
+        return cur
+
+    def _is_lockish(self, segment: str) -> bool:
+        return (segment in self.decl["locks"]
+                or bool(_LOCKISH_RE.search(segment)))
+
+    def _lock_with(self, node: ast.With, expr: ast.AST
+                   ) -> Optional[LockWith]:
+        name = dotted(expr)
+        if name is None:
+            return None
+        segment = name.rsplit(".", 1)[-1]
+        if not self._is_lockish(segment):
+            return None
+        segment = self.decl["aliases"].get(segment, segment)
+        cls = self.enclosing_class(node)
+        if name.startswith("self.") and cls is not None:
+            qualified = f"{self.modname}.{cls.name}.{segment}"
+        else:
+            qualified = f"{self.modname}.{segment}"
+        return LockWith(node, expr, name, segment, qualified)
+
+    def _collect_lock_withs(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                lw = self._lock_with(node, item.context_expr)
+                if lw is not None:
+                    self.lock_withs.append(lw)
+
+    def held_locks(self, node: ast.AST) -> List[LockWith]:
+        """The lock-``with`` statements lexically enclosing ``node``
+        within the same function (innermost first) — what is HELD when
+        ``node`` executes, as far as lexical analysis can say."""
+        by_with = {}
+        for lw in self.lock_withs:
+            by_with.setdefault(lw.node, []).append(lw)
+        held: List[LockWith] = []
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, _SCOPES):
+            if isinstance(cur, ast.With) and cur in by_with:
+                held.extend(by_with[cur])
+            cur = self.parents.get(cur)
+        return held
+
+
+def analyze(source: str, path: str) -> ThreadAnalysis:
+    return ThreadAnalysis(ast.parse(source, filename=path), source, path)

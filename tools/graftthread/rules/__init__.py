@@ -1,0 +1,15 @@
+"""Rule registry: each module exposes RULE, NAME, and check(analysis).
+
+``lock_order`` (T3) additionally exposes ``edges``/``cycle_findings``
+— the driver unions edges across every scanned file and runs the
+cycle check globally (cross-module cycles only close there)."""
+
+from __future__ import annotations
+
+from . import (blocking, callback, lifecycle, lock_order, ordering,
+               settle)
+
+ALL_RULES = (blocking, settle, lock_order, callback, lifecycle,
+             ordering)
+
+RULE_IDS = {mod.RULE for mod in ALL_RULES}

@@ -1,0 +1,99 @@
+"""T1 blocking-call-under-lock.
+
+The PR-6 bug this rule mechanizes: XLA bucket compiles originally ran
+INSIDE the engine lock — a minutes-long compile stalled every weight
+swap and every already-compiled dispatch behind it (fixed by moving
+``lower()/compile()`` outside; engine.py documents the discipline).
+The general form: any call that can block for unbounded time while a
+lock is held turns that lock into a convoy for every other thread —
+and under a Condition it can deadlock outright.
+
+Flagged lexically inside a ``with <lock>:`` body (nested functions
+excluded — a closure runs later, without the lock):
+
+- ``.lower()`` / ``.compile()``  (XLA compile; ``re.compile`` exempt)
+- ``.result()`` / ``.exception()``  (Future waits)
+- ``.join()``  (thread waits)
+- ``sleep()`` / ``time.sleep()``
+- ``.wait()``  on anything OTHER than the held lock itself (waiting on
+  the held Condition releases it — the one legal blocking wait)
+- ``.block_until_ready()`` / ``.fetch()``  (device syncs)
+- ``.get()``  on queue-ish receivers (``*queue*``/``*mailbox*``)
+- bare ``open()``  (filesystem I/O under a lock)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..declarations import ThreadAnalysis, dotted, walk_same_scope
+from ..finding import Finding
+
+RULE = "T1"
+NAME = "blocking-call-under-lock"
+
+_BLOCKING_ATTRS = {"result", "exception", "join", "sleep", "wait",
+                   "fetch", "compile", "lower", "block_until_ready"}
+_QUEUEISH = ("queue", "mailbox", "inbox")
+
+
+def _receiver(call: ast.Call):
+    if isinstance(call.func, ast.Attribute):
+        return dotted(call.func.value)
+    return None
+
+
+def _canonical(a: ThreadAnalysis, expr_dotted: str) -> str:
+    """Alias-resolve a lock expression's last segment so a Condition
+    and the lock it wraps (``aliases={'_decided': '_lock'}``) compare
+    equal — ``self._decided`` and ``self._lock`` are the SAME lock."""
+    prefix, _, seg = expr_dotted.rpartition(".")
+    seg = a.decl["aliases"].get(seg, seg)
+    return f"{prefix}.{seg}" if prefix else seg
+
+
+def check(a: ThreadAnalysis) -> List[Finding]:
+    out: List[Finding] = []
+    seen = set()
+    for lw in a.lock_withs:
+        for node in walk_same_scope(list(lw.node.body)):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            last = name.rsplit(".", 1)[-1]
+            what = None
+            if last in _BLOCKING_ATTRS:
+                if name == "re.compile":
+                    continue        # regex compile, not XLA
+                if last == "wait":
+                    recv = _receiver(node)
+                    if recv is not None and any(
+                            _canonical(a, h.expr_dotted)
+                            == _canonical(a, recv)
+                            for h in a.held_locks(node)):
+                        continue    # Condition.wait on the HELD lock
+                        #             (alias-resolved: `with _lock:
+                        #             _decided.wait()` is the same
+                        #             lock) releases it — the legal
+                        #             idiom
+                what = f"{name}()"
+            elif last == "get":
+                recv = _receiver(node) or ""
+                seg = recv.rsplit(".", 1)[-1].lower()
+                if any(q in seg for q in _QUEUEISH):
+                    what = f"{name}() (blocking queue read)"
+            elif name == "open":
+                what = "open() (filesystem I/O)"
+            if what is None:
+                continue
+            seen.add(id(node))
+            out.append(Finding(
+                a.path, node.lineno, node.col_offset, RULE, NAME,
+                f"{what} can block while holding {lw.expr_dotted} — "
+                "every other thread convoys behind the lock (the PR-6 "
+                "compile-under-engine-lock bug class); move the "
+                "blocking call outside the with body"))
+    return out

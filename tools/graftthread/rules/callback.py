@@ -1,0 +1,55 @@
+"""T4 callback-under-lock.
+
+A caller-supplied callback is arbitrary code: fired while a lock is
+held, it re-enters whatever the listener touches WITH that lock — the
+breaker-listener convention exists because a transition listener that
+recomputes scheduler health reads *other* breakers, and firing it
+inside the breaker lock would deadlock the health recompute
+(resilience.py's ``_set``/``_notify`` split is the blessed shape:
+record the transition under the lock, fire the listener after
+releasing).
+
+Modules declare their listener attributes::
+
+    GRAFTTHREAD = {"callbacks": ("on_transition", "_on_transition")}
+
+Any call to a declared callback name lexically inside a ``with
+<lock>:`` body is a finding. No declaration, no findings — the rule is
+opt-in per module, like the convention it enforces.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..declarations import ThreadAnalysis, dotted, walk_same_scope
+from ..finding import Finding
+
+RULE = "T4"
+NAME = "callback-under-lock"
+
+
+def check(a: ThreadAnalysis) -> List[Finding]:
+    callbacks = set(a.decl["callbacks"])
+    if not callbacks:
+        return []
+    out: List[Finding] = []
+    seen = set()
+    for lw in a.lock_withs:
+        for node in walk_same_scope(list(lw.node.body)):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            name = dotted(node.func)
+            if name is None or name.rsplit(".", 1)[-1] not in callbacks:
+                continue
+            seen.add(id(node))
+            out.append(Finding(
+                a.path, node.lineno, node.col_offset, RULE, NAME,
+                f"listener {name}() fired while holding "
+                f"{lw.expr_dotted} — a callback that reads other "
+                "locked state (the breaker-board health recompute) "
+                "deadlocks; record the transition under the lock, "
+                "fire the listener after releasing (resilience.py's "
+                "_set/_notify split)"))
+    return out

@@ -1,0 +1,122 @@
+"""T5 thread-lifecycle.
+
+The PR-3 loader lesson, generalized: a thread the process cannot
+account for is a leak that surfaces as a hung interpreter exit, a
+stolen mailbox, or a watchdog firing into a torn-down stack. Every
+``threading.Thread`` this stack arms must be:
+
+- **daemon-flagged** (``daemon=True`` in the constructor) — a
+  non-daemon thread blocks interpreter exit forever if its shutdown
+  path is ever missed; and
+- **joined or quarantine-accounted** on the owning class's shutdown
+  path: the class must define a stop-ish method (``close``/``stop``/
+  ``shutdown``/``__exit__``) and either ``join`` a thread somewhere or
+  append to a quarantine roster (an attribute named ``quarantined*``,
+  the DispatchExecutor discipline: Python can't kill a wedged thread,
+  so it is abandoned, replaced, and *accounted* instead of leaked
+  silently).
+
+A thread armed in a plain function must be joined in that function
+(graftlint R5 separately enforces the try/finally shape). Module-level
+arming is process-lifetime by intent and exempt, as in R5.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..declarations import ThreadAnalysis, dotted, walk_same_scope
+from ..finding import Finding
+
+RULE = "T5"
+NAME = "thread-lifecycle"
+
+_STOPPISH = {"close", "stop", "shutdown", "__exit__"}
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted(node.func) in ("threading.Thread", "Thread"))
+
+
+def _daemon_true(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if (kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True):
+            return True
+    return False
+
+
+def _has_join(nodes) -> bool:
+    for node in ast.walk(nodes) if isinstance(nodes, ast.AST) else nodes:
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            return True
+    return False
+
+
+def _has_quarantine_append(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append"):
+            recv = dotted(node.func.value) or ""
+            if "quarantin" in recv.rsplit(".", 1)[-1].lower():
+                return True
+    return False
+
+
+def _stoppish_methods(cls: ast.ClassDef) -> List[ast.AST]:
+    return [n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name in _STOPPISH]
+
+
+def check(a: ThreadAnalysis) -> List[Finding]:
+    out: List[Finding] = []
+    flagged_classes = set()
+    for node in ast.walk(a.tree):
+        if not _is_thread_ctor(node):
+            continue
+        if not _daemon_true(node):
+            out.append(Finding(
+                a.path, node.lineno, node.col_offset, RULE, NAME,
+                "threading.Thread without daemon=True — if the "
+                "shutdown path is ever missed (an exception, a wedge) "
+                "this thread blocks interpreter exit forever; arm it "
+                "daemon and own its lifecycle explicitly"))
+        cls = a.enclosing_class(node)
+        fn = a.enclosing_function(node)
+        if cls is not None:
+            if cls.name in flagged_classes:
+                continue
+            stoppers = _stoppish_methods(cls)
+            ok = (bool(stoppers)
+                  and (_has_join(cls) or _has_quarantine_append(cls)))
+            if not ok:
+                flagged_classes.add(cls.name)
+                out.append(Finding(
+                    a.path, node.lineno, node.col_offset, RULE, NAME,
+                    f"class {cls.name} arms a thread but "
+                    + ("has no close/stop/shutdown/__exit__ path"
+                       if not stoppers else
+                       "never joins it (and keeps no quarantine "
+                       "roster)")
+                    + " — a thread nobody joins or accounts for is a "
+                      "leak (the PR-3 loader lesson); join it on the "
+                      "stop path or quarantine-account it (the "
+                      "DispatchExecutor discipline)"))
+        elif isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # plain-function arming: the join must live in the same
+            # function (R5 covers the try/finally shape)
+            if not _has_join(walk_same_scope(list(fn.body))):
+                out.append(Finding(
+                    a.path, node.lineno, node.col_offset, RULE, NAME,
+                    "thread armed in a function that never joins it — "
+                    "the caller cannot know when (or whether) it "
+                    "exited; join it here or own it in a class with a "
+                    "stop path"))
+        # module-level arming: process-lifetime by intent (R5 parity)
+    return out

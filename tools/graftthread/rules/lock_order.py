@@ -1,0 +1,187 @@
+"""T3 lock-order-cycle.
+
+Deadlock by lock-order inversion is the classic multi-thread failure
+the serving stack's comment-only discipline ("lock order: _state_lock
+-> _cv -> breaker lock", scheduler.py) cannot machine-check — and the
+next replica/ragged rewrite multiplies the thread graph. This rule
+builds one global acquisition graph over every scanned file:
+
+- **declared edges**: consecutive pairs in each module's
+  ``LOCK_ORDER`` chain (qualified ``module.Class.attr`` names —
+  cross-module edges like ``registry.ModelRegistry._lock ->
+  scheduler.MicroBatchScheduler._cv`` are declared by the module that
+  owns the outer lock);
+- **inferred edges**: lexically nested ``with <lock>:`` statements —
+  holding A while acquiring B is an A->B edge whether or not anyone
+  declared it.
+
+Any cycle in the union graph is a finding: two threads walking the
+cycle from different entry points deadlock. The declaration is the
+contract; the inference catches code drifting from it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..declarations import ThreadAnalysis, walk_same_scope
+from ..finding import Finding
+
+RULE = "T3"
+NAME = "lock-order-cycle"
+
+
+def edges(a: ThreadAnalysis) -> List[Dict]:
+    """This file's contribution to the global acquisition graph."""
+    out: List[Dict] = []
+    for chain, lineno in a.lock_order:
+        for src, dst in zip(chain, chain[1:]):
+            out.append({"src": src, "dst": dst, "path": a.path,
+                        "line": lineno, "origin": "declared"})
+    # inferred: a lock-with nested lexically inside another lock-with's
+    # body (same function scope — walk_same_scope stops at closures)
+    for outer in a.lock_withs:
+        for node in walk_same_scope(list(outer.node.body)):
+            if not isinstance(node, ast.With):
+                continue
+            for inner in a.lock_withs:
+                if inner.node is node \
+                        and inner.qualified != outer.qualified:
+                    out.append({"src": outer.qualified,
+                                "dst": inner.qualified,
+                                "path": a.path,
+                                "line": inner.node.lineno,
+                                "origin": "inferred"})
+    return out
+
+
+def find_cycles(edge_list: List[Dict]) -> List[List[str]]:
+    """Elementary cycles in the acquisition graph, via strongly
+    connected components (each SCC with more than one node — or a
+    self-loop — holds at least one cycle; one representative cycle per
+    SCC is reported, deterministically). Exposed for the synthetic-
+    graph unit tests."""
+    graph: Dict[str, set] = {}
+    for e in edge_list:
+        graph.setdefault(e["src"], set()).add(e["dst"])
+        graph.setdefault(e["dst"], set())
+
+    # Tarjan, iterative (rule code must not recurse past recursion
+    # limits on adversarial graphs)
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(sorted(graph[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack[root] = True
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack[w] = True
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                elif on_stack.get(w):
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                u = work[-1][0]
+                low[u] = min(low[u], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    scc.append(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+
+    for node in sorted(graph):
+        if node not in index:
+            strongconnect(node)
+
+    cycles: List[List[str]] = []
+    for scc in sccs:
+        if len(scc) > 1:
+            members = set(scc)
+            # one representative cycle: walk the SCC's edges from its
+            # smallest node until it closes
+            start = min(scc)
+            path = [start]
+            seen = {start}
+            cur = start
+            while True:
+                nxt = min((w for w in graph[cur] if w in members),
+                          default=None)
+                if nxt is None or nxt == start:
+                    break
+                if nxt in seen:
+                    path = path[path.index(nxt):]
+                    break
+                path.append(nxt)
+                seen.add(nxt)
+                cur = nxt
+            cycles.append(path)
+        elif scc[0] in graph[scc[0]]:
+            cycles.append(scc)          # self-loop
+    return sorted(cycles)
+
+
+def _edge_site(edge_list: List[Dict], src: str, dst: str
+               ) -> Optional[Dict]:
+    best = None
+    for e in edge_list:
+        if e["src"] == src and e["dst"] == dst:
+            if best is None or (e["path"], e["line"]) \
+                    < (best["path"], best["line"]):
+                best = e
+    return best
+
+
+def cycle_findings(edge_list: List[Dict]) -> List[Tuple[Finding, Dict]]:
+    """(finding, anchor edge) per cycle in ``edge_list``. The anchor is
+    the cycle's lexicographically-first edge site, so the finding (and
+    any pragma suppressing it) lands deterministically."""
+    out: List[Tuple[Finding, Dict]] = []
+    for cycle in find_cycles(edge_list):
+        pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+        sites = [(pair, _edge_site(edge_list, *pair)) for pair in pairs]
+        sites = [(p, s) for p, s in sites if s is not None]
+        if not sites:
+            continue
+        anchor = min(sites, key=lambda ps: (ps[1]["path"],
+                                            ps[1]["line"]))[1]
+        detail = "; ".join(
+            f"{p[0]} -> {p[1]} ({s['origin']} at {s['path']}:"
+            f"{s['line']})" for p, s in sites)
+        loop = " -> ".join(cycle + cycle[:1])
+        out.append((Finding(
+            anchor["path"], anchor["line"], 0, RULE, NAME,
+            f"lock-order cycle {loop}: two threads entering this loop "
+            f"at different locks deadlock — {detail}; fix the "
+            "acquisition order (or the LOCK_ORDER declaration that "
+            "misstates it)"), anchor))
+    return out
+
+
+def check(a: ThreadAnalysis) -> List[Finding]:
+    """Single-file mode (``lint_file``): cycles visible from this
+    file's own edges. The repo gate runs the GLOBAL graph in the
+    driver instead — cross-module cycles only close there."""
+    return [f for f, _ in cycle_findings(edges(a))]

@@ -1,0 +1,84 @@
+"""T6 consequences-before-futures.
+
+The PR-7 wedge-verdict invariant, machine-checked: when a verdict
+fails a batch's futures, every *consequence* of the verdict — the
+suspect executable dropped, the breaker recorded, the stuck thread
+quarantined — must land BEFORE any future settles, so a caller woken
+by its ``DispatchWedged`` observes consistent state (breaker open,
+bucket gone, health degraded) instead of racing the cleanup. The
+chaos harness asserts this dynamically; this rule pins it in the
+source: in every declared verdict function, the first settle call must
+be lexically preceded by at least one declared consequence call.
+
+Modules opt in by declaring the verdict set and its consequences::
+
+    GRAFTTHREAD = {
+        "verdicts": ("_wedge_verdict", "_wedge_completion"),
+        "consequences": ("drop_bucket", "record_failure",
+                         "quarantine_and_replace"),
+        "settles": ("_fail_requests",),   # extends settle_future
+    }
+
+Lexical (line-order) domination is an approximation of true
+dominator analysis — good enough for straight-line verdict bodies,
+and a verdict gnarly enough to defeat it should be simplified, not
+waved through.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..declarations import ThreadAnalysis, dotted, walk_same_scope
+from ..finding import Finding
+
+RULE = "T6"
+NAME = "consequences-before-futures"
+
+_RAW_SETTLES = {"set_result", "set_exception"}
+
+
+def check(a: ThreadAnalysis) -> List[Finding]:
+    verdicts = set(a.decl["verdicts"])
+    if not verdicts:
+        return []
+    consequences = set(a.decl["consequences"])
+    out: List[Finding] = []
+    for node in ast.walk(a.tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in verdicts):
+            continue
+        settle_calls: List[ast.Call] = []
+        first_consequence = None
+        for sub in walk_same_scope(list(node.body)):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = dotted(sub.func)
+            if name is None:
+                continue
+            last = name.rsplit(".", 1)[-1]
+            if last in a.settles or last in _RAW_SETTLES:
+                settle_calls.append(sub)
+            elif last in consequences:
+                if (first_consequence is None
+                        or sub.lineno < first_consequence):
+                    first_consequence = sub.lineno
+        for call in settle_calls:
+            if first_consequence is None:
+                out.append(Finding(
+                    a.path, call.lineno, call.col_offset, RULE, NAME,
+                    f"verdict {node.name}() settles futures but calls "
+                    "no declared consequence (drop/quarantine/breaker-"
+                    "record) at all — a woken caller would observe a "
+                    "verdict with none of its consequences applied"))
+            elif call.lineno < first_consequence:
+                out.append(Finding(
+                    a.path, call.lineno, call.col_offset, RULE, NAME,
+                    f"verdict {node.name}() settles futures at line "
+                    f"{call.lineno}, before its first consequence at "
+                    f"line {first_consequence} — consequences must "
+                    "land BEFORE the futures fail, or a woken caller "
+                    "races the cleanup (the PR-7 wedge-verdict "
+                    "ordering invariant)"))
+    return out

@@ -1,0 +1,53 @@
+"""T2 unguarded-future-settle.
+
+``Future.set_result``/``set_exception`` raise ``InvalidStateError``
+when the future is already done — and in a serving stack every settle
+races something: a caller's ``cancel()``, a wedge verdict, a
+supervision-loop expiry sweep. PR 7's hardening list is a museum of
+this class (the ``_expire``-vs-cancel race would have killed the
+dispatcher thread from the sweep). The blessed idiom is ONE shared
+helper, ``raft_tpu.serving.futures.settle_future``, which guards the
+race and reports whether the settle won — so per-future accounting
+(submitted == completed + failed + deadline_missed + cancelled) stays
+exact at every site by construction.
+
+This rule flags every raw ``.set_result(``/``.set_exception(`` call.
+The helper module itself declares ``GRAFTTHREAD = {"settle_helper":
+True}`` and is exempt inside its ``settle_future`` function — the one
+place the raw calls belong.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..declarations import ThreadAnalysis
+from ..finding import Finding
+
+RULE = "T2"
+NAME = "unguarded-future-settle"
+
+_SETTLE_ATTRS = {"set_result", "set_exception"}
+
+
+def check(a: ThreadAnalysis) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(a.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SETTLE_ATTRS):
+            continue
+        if a.decl["settle_helper"]:
+            fn = a.enclosing_function(node)
+            if (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and fn.name == "settle_future"):
+                continue   # the one blessed raw-settle site
+        out.append(Finding(
+            a.path, node.lineno, node.col_offset, RULE, NAME,
+            f"raw .{node.func.attr}() — a concurrent cancel/verdict "
+            "makes this raise InvalidStateError and kill the calling "
+            "thread; settle through raft_tpu.serving.futures."
+            "settle_future (guards the race, returns whether the "
+            "settle won so accounting stays exact)"))
+    return out

@@ -1,0 +1,307 @@
+"""Shared static-analysis machinery: pragmas, baselines, parse cache.
+
+graftlint (source tier) and graftthread (thread-safety tier) are the
+same *kind* of tool — walk files, run AST rules, apply per-line pragmas
+and a shrink-only baseline, accelerate repeats with a content-hash
+cache — differing only in their rule packages. This module is the one
+copy of everything below the rules:
+
+- ``parse_pragmas(source, tool)``: tokenizer-backed per-line
+  ``# <tool>: disable=...`` suppression (a string literal that merely
+  CONTAINS the pragma text must not suppress);
+- ``package_signature(*roots)``: content hash over the tool's own
+  ``.py`` files (this module included by the callers) — a cache must
+  never outlive the code that produced it;
+- ``load_cache``/``save_cache``: the content-hash parse cache, atomic
+  last-writer-wins writes (concurrent gate runs may each write; any
+  complete file is a valid cache);
+- ``evict_dead_entries``: superseded-digest and deleted-file eviction,
+  so the shared user-level cache file cannot grow forever;
+- ``map_jobs``: serial or process-pool execution over cache misses;
+- ``load_baseline``/``write_baseline``/``apply_baseline``/``code_line``:
+  the shrink-only grandfather file keyed on (path, rule, source text)
+  — line numbers drift across edits, the triple mostly doesn't.
+
+Pure stdlib; importable by tools that must not import jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from collections import Counter
+from typing import (Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple)
+
+#: directory basenames never entered when walking a directory argument
+#: (the *_fixtures dirs hold intentionally-violating code for the
+#: other tiers' tests — each tool must skip them all, or one tier's
+#: fixtures fail another tier's gate)
+EXCLUDED_DIRS = {"__pycache__", ".git", "graftlint_fixtures",
+                 "graftaudit_fixtures", "graftthread_fixtures",
+                 "node_modules", ".venv"}
+
+
+def collect_files(paths: Sequence[str],
+                  excluded_dirs: Optional[set] = None) -> List[str]:
+    """Expand dir args to ``**/*.py`` (minus excluded dirs); keep
+    explicit file args verbatim (even non-.py: caller's choice)."""
+    excluded = EXCLUDED_DIRS if excluded_dirs is None else excluded_dirs
+    out: List[str] = []
+    seen = set()
+
+    def add(path: str) -> None:
+        key = os.path.normpath(path)
+        if key not in seen:   # a file named explicitly AND reached by a
+            seen.add(key)     # dir walk must lint once, not twice
+            out.append(path)
+
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in excluded)
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        add(os.path.join(root, f))
+        else:
+            add(p)
+    return out
+
+
+# -- pragmas --------------------------------------------------------------
+
+def _pragma_re(tool: str) -> re.Pattern:
+    # rule list only — a trailing bare-word justification ("disable=T5
+    # poll-loop daemon by design") must not be swallowed into the id
+    return re.compile(
+        rf"#\s*{re.escape(tool)}:\s*disable="
+        r"(all|[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+def parse_pragmas(source: str, tool: str) -> Dict[int, Optional[set]]:
+    """line number -> set of disabled rule ids (None = all rules).
+
+    Tokenized, not regexed over raw lines: the pragma must live in an
+    actual COMMENT token."""
+    pragma_re = _pragma_re(tool)
+    pragmas: Dict[int, Optional[set]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return pragmas   # unparsable files already yield E1 findings
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = pragma_re.search(tok.string)
+        if not m:
+            continue
+        spec = m.group(1).strip()
+        line = tok.start[0]
+        if spec.lower() == "all":
+            pragmas[line] = None
+        else:
+            pragmas[line] = {r.strip().upper() for r in spec.split(",")
+                             if r.strip()}
+    return pragmas
+
+
+# -- package signature + cache file ---------------------------------------
+
+_SIG_CACHE: Dict[Tuple[str, ...], str] = {}
+
+
+def package_signature(*roots: str) -> str:
+    """Content hash over every ``.py`` under ``roots`` (dirs or files):
+    editing any rule, driver, or this shared module invalidates every
+    cache entry keyed under the old signature."""
+    key = tuple(os.path.abspath(r) for r in roots)
+    cached = _SIG_CACHE.get(key)
+    if cached is not None:
+        return cached
+    h = hashlib.sha256()
+
+    def feed(path: str) -> None:
+        with open(path, "rb") as fh:
+            h.update(os.path.basename(path).encode() + b"\0" + fh.read())
+
+    for root in key:
+        if os.path.isdir(root):
+            for r, dirs, files in os.walk(root):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        feed(os.path.join(r, f))
+        else:
+            feed(root)
+    sig = h.hexdigest()[:16]
+    _SIG_CACHE[key] = sig
+    return sig
+
+
+def default_cache_path(env_var: str, filename: str) -> str:
+    root = os.environ.get(env_var)
+    if root:
+        return root
+    home = os.path.expanduser("~")
+    base = (os.path.join(home, ".cache") if home != "~"
+            else os.path.join(os.sep, "tmp"))
+    return os.path.join(base, "raft_tpu", filename)
+
+
+def load_cache(path: str, signature: str) -> Dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("sig") == signature:
+            return data
+    except (OSError, ValueError):
+        pass
+    return {"sig": signature, "files": {}}
+
+
+def save_cache(path: str, cache: Dict) -> None:
+    """Atomic, last-writer-wins: concurrent gate runs (pytest spawns
+    several) may each write; any complete file is a valid cache."""
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(cache, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass     # a cache is an accelerator, never a correctness gate
+
+
+def file_digest(path: str) -> Optional[str]:
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def cache_key(path: str, digest: str, rule_key: str) -> str:
+    """ABSOLUTE key paths: the default cache is user-global, so
+    cwd-relative keys from two working directories would collide and
+    evict each other."""
+    return f"{os.path.abspath(path)}|{digest}|{rule_key}"
+
+
+def evict_dead_entries(cache: Dict, hashes: Dict[str, str]) -> None:
+    """Evict dead weight — without this the shared user-level file
+    grows forever: entries for a file seen this run under a superseded
+    digest (any rule filter), and entries whose file no longer exists
+    at all (deleted/renamed paths; keys are absolute, so the exists()
+    check is cwd-independent)."""
+    current = {os.path.abspath(p): d for p, d in hashes.items()}
+    alive: Dict[str, bool] = {}
+    for key in list(cache["files"]):
+        path, digest = key.split("|", 2)[:2]
+        if path in current:
+            if digest != current[path]:
+                del cache["files"][key]
+        else:
+            if path not in alive:
+                alive[path] = os.path.exists(path)
+            if not alive[path]:
+                del cache["files"][key]
+
+
+def map_jobs(worker: Callable, items: List, jobs: int) -> List:
+    """Run ``worker`` over ``items``, serially or on a process pool.
+    ``worker`` must be a module-level (picklable) function."""
+    if jobs > 1 and len(items) > 1:
+        import multiprocessing
+
+        with multiprocessing.Pool(min(jobs, len(items))) as pool:
+            return pool.map(worker, items)
+    return [worker(i) for i in items]
+
+
+# -- baselines ------------------------------------------------------------
+
+# keyed on (mtime, size) so library users that lint across edits (a
+# pytest process, an editor integration) never key a baseline entry
+# off stale content
+_LINES_CACHE: Dict[str, Tuple[Tuple[float, int], List[str]]] = {}
+
+
+def code_line(path: str, line: int) -> str:
+    try:
+        st = os.stat(path)
+        stamp = (st.st_mtime, st.st_size)
+    except OSError:
+        return ""
+    cached = _LINES_CACHE.get(path)
+    if cached is None or cached[0] != stamp:
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            lines = []
+        _LINES_CACHE[path] = (stamp, lines)
+    else:
+        lines = cached[1]
+    if 1 <= line <= len(lines):
+        return lines[line - 1].strip()
+    return ""
+
+
+def load_baseline(path: str) -> Counter:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return Counter(
+        (e["path"].replace("\\", "/"), e["rule"], e["code"])
+        for e in data.get("findings", []))
+
+
+def write_baseline(path: str, keys: Iterable[Tuple[str, str, str]],
+                   tool: str) -> None:
+    entries = [{"path": k[0], "rule": k[1], "code": k[2]}
+               for k in sorted(keys)]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({
+            "comment": f"{tool} grandfathered findings — burn down, "
+                       "never grow; regenerate with --write-baseline "
+                       "after fixing one",
+            "findings": entries,
+        }, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: List, baseline: Counter,
+                   finding_key: Callable,
+                   linted_paths: Optional[Iterable[str]] = None,
+                   ) -> Tuple[List, List[Tuple[str, str, str]]]:
+    """Returns (new findings, stale baseline keys).
+
+    Stale entries are NOT a free pass: an unconsumed entry would
+    silently grandfather the next reintroduction of that exact line,
+    so the CLIs fail on them and demand a regenerate (the baseline
+    must only ever shrink, and shrink EXPLICITLY). An entry whose file
+    was not in ``linted_paths`` at all (a partial run) is merely
+    unchecked, not stale; ``linted_paths=None`` treats every
+    unconsumed entry as stale."""
+    remaining = Counter(baseline)
+    new: List = []
+    for f in findings:
+        k = finding_key(f)
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+        else:
+            new.append(f)
+    if linted_paths is not None:
+        linted = {os.path.normpath(p).replace("\\", "/")
+                  for p in linted_paths}
+        checked = (lambda k: os.path.normpath(k[0]).replace("\\", "/")
+                   in linted)
+    else:
+        checked = (lambda k: True)
+    stale = sorted(k for k, n in remaining.items() if checked(k)
+                   for _ in range(n))
+    return new, stale
