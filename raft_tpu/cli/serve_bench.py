@@ -19,6 +19,16 @@ session state); the summary line then carries the A/B surface —
 ``h2d_bytes_per_req``, ``dispatch_gap_{mean,p50,p99}_ms``,
 ``overlap_ratio`` — against a baseline run of the same traffic.
 
+``--feature-cache`` arms the cross-frame device feature cache
+(serving/feature_cache): video sessions serve through the CACHED
+bucket signature — steady-state pairs cost ONE encoder pass and ship
+ONE frame of H2D — and the summary grows ``warm_pairs_per_s``,
+``cache_hit_rate``, ``cache_evictions``. The video-heavy traffic mode
+is ``--requests 0 --sessions M --session-frames N`` (long streams, no
+one-shot noise); run the SAME line with and without the flag for the
+A/B (``serve_cache_r6`` vs its ``_base`` leg in
+tools/onchip_round6.sh is that pair at real shapes).
+
 ``--chaos N`` instead runs N rounds of randomized fault plans
 (raise/hang at ``serve.request`` / ``serve.dispatch_exec`` /
 ``engine.compile``, seeded probabilities and nth-call scoping) through
@@ -117,6 +127,7 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
               dispatch_timeout_s=None, breaker_failures=0,
               breaker_backoff_s=0.25, breaker_backoff_max_s=30.0,
               wire="f32", pipeline_depth=1, session_device_state=False,
+              feature_cache=False, cache_capacity=256,
               fault_plan=None, recover_s=0.0,
               metrics_path=None, seed=0, engine=None):
     """The drill as a library call (tests reuse it, and may pass a
@@ -127,10 +138,18 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     in a finally). ``recover_s`` > 0 runs a post-traffic recovery
     phase: per shape, retry probes until one serves or the budget runs
     out — the half-open probe path that closes an opened breaker and
-    lazily recompiles a dropped bucket."""
+    lazily recompiles a dropped bucket.
+
+    ``feature_cache=True`` arms the cross-frame device feature cache
+    (engine cached signature + scheduler pool of ``cache_capacity``
+    slots) and runs every video session through it — the video-warm
+    A/B: same traffic with the flag off is the baseline the
+    ``warm_pairs_per_s``/``cache_hit_rate`` summary fields compare
+    against."""
     import numpy as np
 
     from raft_tpu.serving.engine import RAFTEngine
+    from raft_tpu.serving.feature_cache import FeatureCacheMiss
     from raft_tpu.serving.resilience import CircuitOpen, DispatchWedged
     from raft_tpu.serving.scheduler import (BackpressureError,
                                             DeadlineExceeded,
@@ -144,8 +163,11 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                            for h, w in shapes})
         engine = RAFTEngine(variables, cfg, iters=iters,
                             envelope=envelope, precompile=True,
-                            warm_start=True, wire=wire)
-    documented = len(engine._compiled)
+                            warm_start=True, wire=wire,
+                            feature_cache=feature_cache)
+    _n_exec = getattr(engine, "executable_count",
+                      lambda: len(engine._compiled))
+    documented = _n_exec()
     sched = MicroBatchScheduler(engine, max_queue=max_queue,
                                 max_batch=bucket_batch,
                                 gather_window_s=gather_window_s,
@@ -155,7 +177,22 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                                 breaker_backoff_max_s=breaker_backoff_max_s,
                                 breaker_rng=random.Random(seed),
                                 pipeline_depth=pipeline_depth,
+                                feature_cache=feature_cache,
+                                feature_cache_capacity=cache_capacity,
                                 metrics_path=metrics_path)
+    if feature_cache and sessions:
+        # compile-outside-the-measurement discipline (the engine's
+        # envelope precompile, one layer up): the device forward-warp
+        # jit compiles per 1/8-res shape — warm it here so the first
+        # warm pair doesn't pay a one-off compile inside the timed
+        # window the A/B compares
+        import jax.numpy as jnp
+
+        from raft_tpu.ops.interp import forward_interpolate_device
+        for h, w in shapes:
+            forward_interpolate_device(
+                jnp.zeros((_ceil8(h) // 8, _ceil8(w) // 8, 2))
+            ).block_until_ready()
     futures = [[] for _ in range(submitters)]
     shed = [0] * submitters
     rejected = [0] * submitters
@@ -182,13 +219,19 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
         rng = np.random.RandomState(seed + 1000 + sid)
         h, w = shapes[sid % len(shapes)]
         sess = VideoSession(sched, deadline_s=deadline_s,
-                            device_state=session_device_state)
+                            device_state=session_device_state,
+                            feature_cache=feature_cache)
         futs = []
         for _ in range(session_frames + 1):
             try:
                 futs.append(sess.submit_frame(
                     rng.rand(h, w, 3).astype(np.float32) * 255))
-            except (BackpressureError, CircuitOpen):
+            except (BackpressureError, CircuitOpen,
+                    FeatureCacheMiss):
+                # a FeatureCacheMiss here is a failed re-prime (under
+                # injected faults) or sustained capacity churn past
+                # the session's bounded re-prime retries — counted
+                # like any other lost pair
                 session_stats["errors"] += 1
         for f in futs:
             if f is None:
@@ -262,7 +305,7 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                 circuit += 1
             except Exception:
                 errors += 1
-    rec = sched.metrics.snapshot(executables=len(engine._compiled))
+    rec = sched.metrics.snapshot(executables=_n_exec())
     total_served = served + session_stats["pairs"]
     occ = rec["occupancy"]
     accounted = (rec["completed"] + rec["failed"]
@@ -270,6 +313,7 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     open_buckets = sum(1 for b in health["buckets"].values()
                        if b["state"] != "closed")
     hot = rec["hot_path"]
+    fc = rec.get("feature_cache") or {}
     return {
         "wire": getattr(engine, "wire", "f32"),
         "pipeline_depth": pipeline_depth,
@@ -286,7 +330,7 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
         "accounting_ok": rec["submitted"] == accounted,
         "abandoned_inflight": rec["abandoned_inflight"],
         "dispatches": rec["dispatches"],
-        "executables": len(engine._compiled),
+        "executables": _n_exec(),
         "documented_buckets": documented,
         "mean_occupancy": occ["mean"],
         "baseline_occupancy": occ["one_per_dispatch_baseline"],
@@ -308,6 +352,14 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
         "dispatch_gap_p50_ms": hot["dispatch_gap"]["p50_ms"],
         "dispatch_gap_p99_ms": hot["dispatch_gap"]["p99_ms"],
         "overlap_ratio": hot["assembly"]["overlap_ratio"],
+        # video-warm A/B surface (feature cache): warm throughput +
+        # the pool's truth about whether streams actually stayed warm
+        "feature_cache": bool(feature_cache),
+        "warm_pairs_per_s": (round(session_stats["warm"] / wall, 2)
+                             if wall else 0.0),
+        "cache_hit_rate": fc.get("hit_rate", 0.0),
+        "cache_evictions": fc.get("evictions", 0),
+        "cache_occupancy": fc.get("occupancy", 0),
         "wall_s": round(wall, 3),
         "pairs_per_s": round(total_served / wall, 2) if wall else 0.0,
     }
@@ -340,6 +392,7 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                     gather_window_s=0.0, max_queue=64,
                     wire="f32", pipeline_depth=1, sessions=0,
                     session_frames=4, session_device_state=False,
+                    feature_cache=False, cache_capacity=256,
                     deadline_s=None, seed=0, metrics_path=None,
                     engine=None):
     """``rounds`` randomized fault rounds + one clean recovery round
@@ -360,8 +413,10 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
         engine = RAFTEngine(variables, cfg, iters=iters,
                             envelope=envelope, precompile=True,
                             warm_start=True, exact_shapes=True,
-                            wire=wire)
-    documented = len(engine._compiled)
+                            wire=wire, feature_cache=feature_cache)
+    _n_exec = getattr(engine, "executable_count",
+                      lambda: len(engine._compiled))
+    documented = _n_exec()
     per_round = []
     violations = []
     common = dict(shapes=shapes, requests=requests,
@@ -375,6 +430,8 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                   pipeline_depth=pipeline_depth, sessions=sessions,
                   session_frames=session_frames,
                   session_device_state=session_device_state,
+                  feature_cache=feature_cache,
+                  cache_capacity=cache_capacity,
                   recover_s=recover_s, metrics_path=metrics_path,
                   engine=engine)
     sites = (CHAOS_SITES_PIPELINED if pipeline_depth > 1
@@ -408,10 +465,19 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
             f"clean round: health {s['health_state']} != healthy")
     if s["served"] != s["accepted"]:
         violations.append("clean round: served != accepted traffic")
-    if len(engine._compiled) != documented:
+    if _n_exec() != documented:
         violations.append(
-            f"executables {len(engine._compiled)} != documented "
+            f"executables {_n_exec()} != documented "
             f"{documented} after recovery (leaked/lost bucket)")
+    if feature_cache:
+        # the pool must never leak past its bound — capacity is the
+        # memory contract thousands of sessions lean on
+        for p in per_round:
+            if p["cache_occupancy"] > cache_capacity:
+                violations.append(
+                    f"round {p['round']}: cache occupancy "
+                    f"{p['cache_occupancy']} > capacity "
+                    f"{cache_capacity} (leaked slots)")
     totals = {k: sum(p[k] for p in per_round) for k in
               ("submitted", "served", "shed", "circuit_rejected",
                "deadline_missed", "failed_wedged", "failed_circuit",
@@ -422,7 +488,7 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
         "chaos_rounds": rounds,
         "violations": violations,
         "documented_buckets": documented,
-        "executables": len(engine._compiled),
+        "executables": _n_exec(),
         "breaker_transitions": transitions,
         "totals": totals,
         "per_round": per_round,
@@ -1049,6 +1115,17 @@ def main(argv=None):
                    help="video sessions keep flow_low on device "
                         "between pairs (on-device forward warp) "
                         "instead of the per-frame D2H→H2D round trip")
+    p.add_argument("--feature-cache", action="store_true",
+                   help="arm the cross-frame device feature cache: "
+                        "video sessions serve through the cached "
+                        "bucket signature — one encoder pass and ONE "
+                        "frame of H2D per steady-state pair; summary "
+                        "grows warm_pairs_per_s / cache_hit_rate / "
+                        "cache_evictions (A/B against the same "
+                        "traffic without the flag)")
+    p.add_argument("--cache-capacity", type=int, default=256,
+                   help="feature-cache pool slots (LRU beyond; the "
+                        "per-stream device-memory bound)")
     p.add_argument("--models", default=None,
                    help="comma list of arch names (basic|small) to "
                         "serve as independent live models behind a "
@@ -1234,6 +1311,8 @@ def main(argv=None):
             wire=args.wire, pipeline_depth=args.pipeline_depth,
             sessions=args.sessions, session_frames=args.session_frames,
             session_device_state=args.device_state,
+            feature_cache=args.feature_cache,
+            cache_capacity=args.cache_capacity,
             max_queue=args.queue, seed=args.seed,
             metrics_path=metrics_path)
         print(json.dumps(summary), flush=True)
@@ -1255,6 +1334,8 @@ def main(argv=None):
                                   args.breaker_backoff_ms) / 1e3,
         wire=args.wire, pipeline_depth=args.pipeline_depth,
         session_device_state=args.device_state,
+        feature_cache=args.feature_cache,
+        cache_capacity=args.cache_capacity,
         recover_s=args.recover_s,
         metrics_path=metrics_path, seed=args.seed)
     print(json.dumps(summary), flush=True)

@@ -77,35 +77,12 @@ class RAFT(nn.Module):
             else:
                 self.update_block = BasicUpdateBlock(cfg.hidden_dim, dt)
 
-    def __call__(self, image1, image2, iters: int = 12,
-                 flow_init: Optional[jax.Array] = None,
-                 test_mode: bool = False, train: bool = False,
-                 freeze_bn: bool = False, raw_predictions: bool = False):
-        """Estimate flow. Images: (B, H, W, 3) float in [0, 255], H, W % 8 == 0.
-
-        Returns all per-iteration upsampled flows (iters, B, H, W, 2) in
-        train mode, or ``(flow_low, flow_up)`` in test mode. With
-        ``raw_predictions=True`` (basic model, train mode) the stack comes
-        back in the upsampler's subpixel domain (iters, B, 2, 64, H/8·W/8 —
-        see ops/flow_ops.convex_upsample_batched_raw) for the fused
-        sequence loss; the full-res stack never materializes.
-        """
+    def _corr_setup(self, fmap1, fmap2):
+        """Correlation state + per-iteration lookup fn for an fp32 fmap
+        pair — the ``corr_impl`` dispatch, shared by ``__call__`` and
+        the cross-frame cached serving path (``forward_cached``), so
+        the two can never drift."""
         cfg = self.config
-        dt = cfg.compute_dtype
-        B, H, W, _ = image1.shape
-        assert H % 8 == 0 and W % 8 == 0, "pad inputs with InputPadder first"
-        ura = (not train) or freeze_bn  # BatchNorm running-average switch
-
-        # normalize to [-1, 1] (core/raft.py:89-90)
-        image1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
-        image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
-
-        # feature network over both images as one batch
-        fmaps = self.fnet(jnp.concatenate([image1, image2], axis=0),
-                          train=train, use_running_average=ura)
-        fmap1 = fmaps[:B].astype(jnp.float32)   # fp32 island for correlation
-        fmap2 = fmaps[B:].astype(jnp.float32)
-
         if cfg.alternate_corr:
             pyr = [fmap2]
             f2 = fmap2
@@ -163,12 +140,16 @@ class RAFT(nn.Module):
 
                 def lookup(state, coords):
                     return lookup_fn(state, coords, cfg.corr_radius)
+        return corr_state, lookup
 
-        # context network (core/raft.py:110-114)
-        cnet = self.cnet(image1, train=train, use_running_average=ura)
-        net = jnp.tanh(cnet[..., :cfg.hidden_dim]).astype(dt)
-        inp = nn.relu(cnet[..., cfg.hidden_dim:]).astype(dt)
-
+    def _refine(self, corr_state, lookup, net, inp, B, H, W,
+                iters: int, flow_init, test_mode: bool,
+                raw_predictions: bool = False):
+        """The scanned refinement recurrence + upsampling tail, from
+        initialized flow coordinates to the mode's return values —
+        shared verbatim by ``__call__`` and ``forward_cached``."""
+        cfg = self.config
+        dt = cfg.compute_dtype
         coords0, coords1 = initialize_flow(B, H // 8, W // 8)
         if flow_init is not None:
             coords1 = coords1 + flow_init
@@ -242,6 +223,113 @@ class RAFT(nn.Module):
         else:
             flow_predictions = convex_upsample_batched(*ys)
         return flow_predictions
+
+    def __call__(self, image1, image2, iters: int = 12,
+                 flow_init: Optional[jax.Array] = None,
+                 test_mode: bool = False, train: bool = False,
+                 freeze_bn: bool = False, raw_predictions: bool = False):
+        """Estimate flow. Images: (B, H, W, 3) float in [0, 255], H, W % 8 == 0.
+
+        Returns all per-iteration upsampled flows (iters, B, H, W, 2) in
+        train mode, or ``(flow_low, flow_up)`` in test mode. With
+        ``raw_predictions=True`` (basic model, train mode) the stack comes
+        back in the upsampler's subpixel domain (iters, B, 2, 64, H/8·W/8 —
+        see ops/flow_ops.convex_upsample_batched_raw) for the fused
+        sequence loss; the full-res stack never materializes.
+        """
+        cfg = self.config
+        dt = cfg.compute_dtype
+        B, H, W, _ = image1.shape
+        assert H % 8 == 0 and W % 8 == 0, "pad inputs with InputPadder first"
+        ura = (not train) or freeze_bn  # BatchNorm running-average switch
+
+        # normalize to [-1, 1] (core/raft.py:89-90)
+        image1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
+        image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
+
+        # feature network over both images as one batch
+        fmaps = self.fnet(jnp.concatenate([image1, image2], axis=0),
+                          train=train, use_running_average=ura)
+        fmap1 = fmaps[:B].astype(jnp.float32)   # fp32 island for correlation
+        fmap2 = fmaps[B:].astype(jnp.float32)
+
+        corr_state, lookup = self._corr_setup(fmap1, fmap2)
+
+        # context network (core/raft.py:110-114)
+        cnet = self.cnet(image1, train=train, use_running_average=ura)
+        net = jnp.tanh(cnet[..., :cfg.hidden_dim]).astype(dt)
+        inp = nn.relu(cnet[..., cfg.hidden_dim:]).astype(dt)
+
+        return self._refine(corr_state, lookup, net, inp, B, H, W,
+                            iters, flow_init, test_mode, raw_predictions)
+
+    def forward_cached(self, image2, fmap1, cnet1,
+                       flow_init: jax.Array, iters: int = 12):
+        """Cross-frame cached serving: encode ONLY the new frame.
+
+        For consecutive video pairs the previous dispatch already
+        encoded this pair's first frame — frame t's ``fmap2`` and a
+        speculative context encoding ARE pair (t, t+1)'s
+        ``fmap1``/context inputs — so per-stream device caches
+        (serving/feature_cache) hand them back instead of re-running
+        the encoders: steady-state video costs one encoder pass + one
+        recurrence per frame instead of two (the compiler-first O(1)
+        autoregressive-cache discipline of arXiv 2603.09555, applied
+        to RAFT's encoder state).
+
+        ``image2``: (B, H, W, 3) float in [0, 255] — the NEW frame
+        only; the pair's first frame never ships. ``fmap1``: (B, H/8,
+        W/8, fnet_dim) fp32 — the previous call's ``fmap2`` output.
+        ``cnet1``: (B, H/8, W/8, cnet_dim) fp32 — the previous call's
+        speculative context (raw ``cnet`` output; the tanh/relu split
+        happens here, on bits identical to what ``__call__`` would
+        see — fp32 storage round-trips any compute dtype losslessly).
+        ``flow_init``: (B, H/8, W/8, 2) recurrence warm start (zeros =
+        cold recurrence).
+
+        Returns ``(flow_low, flow_up, fmap2, cnet2)`` — the test-mode
+        pair plus this frame's cache outputs (both fp32). A ZEROED
+        fmap1/cnet1 row is the PRIME form of a cold start: its flow
+        outputs are refinement against zero features (meaningless, and
+        the serving layer never surfaces them) but its cache outputs
+        are exactly this frame's features — the next pair's warm
+        inputs — which is how cold and warm stream rows coalesce into
+        ONE bucket executable.
+
+        Bitwise note: the feature net runs at batch B here vs 2B in
+        ``__call__``; XLA CPU conv bits move with TOTAL batch size
+        once it exceeds the vectorization width (batch 1 == 2,
+        2 != 4 — pinned in tests/test_feature_cache.py), so the
+        bitwise cached-vs-uncached pin holds at the bucket-batch-1
+        serving geometry and is allclose-tight above it.
+        """
+        cfg = self.config
+        dt = cfg.compute_dtype
+        B, H, W, _ = image2.shape
+        assert H % 8 == 0 and W % 8 == 0, "pad inputs with InputPadder first"
+
+        image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
+        fmap2 = self.fnet(image2, train=False,
+                          use_running_average=True).astype(jnp.float32)
+        # speculative context for the NEXT pair (this frame will be its
+        # frame 1) — the one extra encoder pass that makes the stream
+        # self-sustaining
+        cnet2 = self.cnet(image2, train=False, use_running_average=True)
+
+        corr_state, lookup = self._corr_setup(
+            fmap1.astype(jnp.float32), fmap2)
+
+        # cached context: cast back to the encoder's own dtype so the
+        # tanh/relu split sees the exact bits __call__ would (fp32
+        # caching of a bf16 value is a lossless round trip)
+        cnet1 = cnet1.astype(cnet2.dtype)
+        net = jnp.tanh(cnet1[..., :cfg.hidden_dim]).astype(dt)
+        inp = nn.relu(cnet1[..., cfg.hidden_dim:]).astype(dt)
+
+        flow_low, flow_up = self._refine(
+            corr_state, lookup, net, inp, B, H, W, iters, flow_init,
+            True)
+        return flow_low, flow_up, fmap2, cnet2.astype(jnp.float32)
 
 
 def create_raft(config: RAFTConfig = RAFTConfig()):

@@ -21,7 +21,10 @@ auto-rollback, plus the registry-wide admission budget that keeps one
 model's flood out of every other model's queue headroom.
 """
 
-from raft_tpu.serving.engine import SHAPE_ENVELOPE_LINUX, RAFTEngine
+from raft_tpu.serving.engine import (SHAPE_ENVELOPE_LINUX, RAFTEngine,
+                                     StaleFeatureError)
+from raft_tpu.serving.feature_cache import (FeatureCacheMiss,
+                                            FeatureCachePool)
 from raft_tpu.serving.futures import settle_future
 from raft_tpu.serving.guardian import (AdmissionBudget, GuardianPolicy,
                                        SLOGuardian)
@@ -46,4 +49,5 @@ __all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX", "MicroBatchScheduler",
            "DeployError", "RolloutInProgress", "UnknownModel",
            "canary_hash_fraction", "PRIORITY_INTERACTIVE",
            "PRIORITY_BATCH", "SLOGuardian", "GuardianPolicy",
-           "AdmissionBudget", "settle_future"]
+           "AdmissionBudget", "settle_future", "FeatureCachePool",
+           "FeatureCacheMiss", "StaleFeatureError"]

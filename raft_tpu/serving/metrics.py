@@ -23,7 +23,7 @@ import os
 import sys
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 #: graftthread T3: the metrics lock is a LEAF — record_* calls arrive
 #: from under the scheduler's queue lock (``_cv``), so taking any
@@ -186,6 +186,14 @@ class ServingMetrics:
         self.h2d_requests = 0
         self._assembly_ms = 0.0
         self._assembly_overlapped_ms = 0.0
+        #: cross-frame feature cache (serving/feature_cache): when the
+        #: scheduler arms a pool it points this at the pool's
+        #: ``snapshot`` — every metrics snapshot then carries a
+        #: ``feature_cache`` block (hits/misses/evictions/flushes/
+        #: occupancy). Called with NO metrics lock held (the pool lock
+        #: stays a leaf; see the T3 declarations). None = no block,
+        #: the historical schema byte for byte.
+        self.feature_cache_provider: Optional[Callable[[], Dict]] = None
 
     # -- recording --------------------------------------------------------
 
@@ -392,6 +400,10 @@ class ServingMetrics:
         """One self-contained record: counters, queue-depth gauges,
         occupancy vs the one-request-per-dispatch baseline, and the
         per-bucket stage histograms."""
+        # read the feature-cache block BEFORE taking the metrics lock:
+        # the pool lock is a leaf and must never nest under this one
+        prov = self.feature_cache_provider
+        fcache = prov() if prov is not None else None
         with self._lock:
             self._snapshots += 1
             filled = sum(b["filled"] for b in self._buckets.values())
@@ -474,6 +486,8 @@ class ServingMetrics:
                     for key, b in sorted(self._buckets.items())
                 },
             }
+            if fcache is not None:
+                rec["feature_cache"] = fcache
             if self.namespace is not None:
                 rec["model"] = self.namespace
         return rec

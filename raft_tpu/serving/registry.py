@@ -248,9 +248,13 @@ class ModelRegistry:
                                 **engine_kw)
         ns = f"{name}@{version}"
         metrics = ServingMetrics(self._metrics_path, namespace=ns)
+        merged = {**self._sched_defaults, **sched_kw}
+        if getattr(engine, "feature_cache", False):
+            # a feature-cache engine gets a feature-cache scheduler:
+            # the per-variant pool is what the rollout brooms flush
+            merged.setdefault("feature_cache", True)
         sched = MicroBatchScheduler(
-            engine, metrics=metrics, namespace=ns,
-            **{**self._sched_defaults, **sched_kw})
+            engine, metrics=metrics, namespace=ns, **merged)
         return _Variant(engine, sched, version, config, MODEL_LOADING,
                         same_arch=same_arch)
 
@@ -273,6 +277,7 @@ class ModelRegistry:
                   engine: Optional[RAFTEngine] = None,
                   warm_start: bool = False, wire: str = "f32",
                   exact_shapes: bool = False,
+                  feature_cache: bool = False,
                   **sched_kw) -> None:
         """Register a model family; the first version goes straight
         live (``loading -> live``). ``engine=`` injects a prebuilt
@@ -290,7 +295,8 @@ class ModelRegistry:
             name, variables, config or RAFTConfig(), version,
             iters=iters, envelope=envelope,
             engine_kw=dict(warm_start=warm_start, wire=wire,
-                           exact_shapes=exact_shapes),
+                           exact_shapes=exact_shapes,
+                           feature_cache=feature_cache),
             sched_kw=sched_kw, engine=engine)
         with self._lock:
             # re-checked at publish: the build ran outside the lock
@@ -362,7 +368,9 @@ class ModelRegistry:
                     warm_start=getattr(live.engine, "warm_start", False),
                     wire=getattr(live.engine, "wire", "f32"),
                     exact_shapes=getattr(live.engine, "exact_shapes",
-                                         False)),
+                                         False),
+                    feature_cache=getattr(live.engine, "feature_cache",
+                                          False)),
                 sched_kw=sched_kw, engine=engine, same_arch=same_arch)
         except Exception as exc:
             # auto-rollback: nothing was routed, nothing is left. The
@@ -426,6 +434,14 @@ class ModelRegistry:
             # dispatches (the engine snapshots its tree per dispatch),
             # executables reused — the cheap path PR-6 built
             live.engine.update_weights(canary.engine.variables)
+            # feature-cache broom: every slot in the live pool was
+            # computed by the OLD weights — stale canary-era features
+            # must never feed the promoted model (streams re-prime;
+            # the engine's weights-version stamp backstops the racing
+            # in-flight window)
+            flush = getattr(live.scheduler, "flush_feature_cache", None)
+            if flush is not None:
+                flush("promote", model=m.name, version=canary.version)
             live.version = canary.version
             self._drain(m.name, canary)
             m.retired.append(canary)
@@ -453,6 +469,13 @@ class ModelRegistry:
                     f"model {m.name!r} has no canary to roll back")
             m.canary = None
             m.canary_fraction = 0.0
+        # the canary's pool dies with it, but flush explicitly (and
+        # stamped) BEFORE the drain: its slots hold canary-weight
+        # features no surviving variant may ever correlate against,
+        # and the cache_flush event is the rollback drill's evidence
+        flush = getattr(canary.scheduler, "flush_feature_cache", None)
+        if flush is not None:
+            flush("rollback", model=m.name, version=canary.version)
         self._drain(m.name, canary)
         m.retired.append(canary)
         self._events.record_event("model_rollback", model=m.name,
@@ -472,6 +495,20 @@ class ModelRegistry:
             frac = m.canary_fraction
         return canary_hash_fraction(m.name, token) < frac
 
+    def _routed_variant(self, m: _Model, route_key) -> _Variant:
+        """The variant a ``route_key`` request routes to right now —
+        the single read-only form of the canary-hash decision
+        (``variant_version`` and ``invalidate_stream`` share it; the
+        submit path's ``_route_and_admit`` fuses the same expression
+        with its counter-bump atom)."""
+        with self._lock:
+            canary = m.canary
+            if (canary is not None and route_key is not None
+                    and canary_hash_fraction(m.name, route_key)
+                    < m.canary_fraction):
+                return canary
+            return m.live
+
     def variant_version(self, name: Optional[str] = None,
                         route_key=None) -> str:
         """Version string of the variant a ``route_key`` request would
@@ -480,14 +517,8 @@ class ModelRegistry:
         changes: a rollout event (deploy/promote/rollback) must never
         let warm-start state produced by one variant feed another
         model's refinement."""
-        m = self._model(name)
-        with self._lock:
-            canary = m.canary
-            if (canary is not None and route_key is not None
-                    and canary_hash_fraction(m.name, route_key)
-                    < m.canary_fraction):
-                return canary.version
-            return m.live.version
+        return self._routed_variant(self._model(name),
+                                    route_key).version
 
     def submit(self, image1, image2, *, model: Optional[str] = None,
                priority: Optional[str] = None, route_key=None, **kw):
@@ -501,6 +532,46 @@ class ModelRegistry:
         ``priority`` is the scheduler's class knob, applied per model.
         Remaining kwargs are the scheduler's (deadline_s, flow_init,
         want_low, low_device)."""
+        return self._route_and_admit(
+            model, priority, route_key,
+            lambda sched: sched.submit(image1, image2,
+                                       priority=priority, **kw))
+
+    def submit_cached(self, frame, *, model: Optional[str] = None,
+                      priority: Optional[str] = None, route_key=None,
+                      **kw):
+        """Feature-cache form of :meth:`submit`: route ONE frame of a
+        video stream to ``model``'s live or canary variant and enqueue
+        it on that variant's ``MicroBatchScheduler.submit_cached``
+        (``stream``/``seq``/``prime`` ride in ``kw``). Same
+        deterministic canary hash, same admission budget, same
+        re-route-on-drain contract — note a re-routed stream's next
+        pair misses on the new variant's pool and cleanly re-primes
+        (the session's cold-restart path)."""
+        return self._route_and_admit(
+            model, priority, route_key,
+            lambda sched: sched.submit_cached(frame,
+                                              priority=priority,
+                                              **kw))
+
+    def invalidate_stream(self, stream, *, model: Optional[str] = None,
+                          route_key=None) -> bool:
+        """End-of-stream hygiene for feature-cache sessions: drop the
+        stream's slot from the variant its ``route_key`` currently
+        routes to (if a rollout moved the stream since it last served,
+        the old variant's pool was flushed or retired with it)."""
+        target = self._routed_variant(self._model(model), route_key)
+        inv = getattr(target.scheduler, "invalidate_stream", None)
+        return inv(stream) if inv is not None else False
+
+    def _route_and_admit(self, model: Optional[str],
+                         priority: Optional[str], route_key, call):
+        """The shared intake skeleton behind ``submit`` and
+        ``submit_cached``: pick the variant (deterministic canary
+        hash over the route token), pass the registry-wide admission
+        gate, run ``call`` against the chosen scheduler with the
+        re-route-on-drain guard, and tie the admission token to the
+        future's settlement."""
         m = self._model(model)
         with self._lock:
             if self._closed:
@@ -525,8 +596,7 @@ class ModelRegistry:
                 f"({self._budget.capacity} requests in flight across "
                 "models) — shedding new work; retry with backoff")
         try:
-            fut = self._submit_variant(m, target, image1, image2,
-                                       priority, kw)
+            fut = self._submit_variant(m, target, call)
         except BaseException:
             if self._budget is not None:
                 self._budget.release()   # nothing was admitted
@@ -535,11 +605,9 @@ class ModelRegistry:
             fut.add_done_callback(lambda _f: self._budget.release())
         return fut
 
-    def _submit_variant(self, m: _Model, target: _Variant, image1,
-                        image2, priority: Optional[str], kw: Dict):
+    def _submit_variant(self, m: _Model, target: _Variant, call):
         try:
-            return target.scheduler.submit(image1, image2,
-                                           priority=priority, **kw)
+            return call(target.scheduler)
         except SchedulerClosed:
             # raced a promote/rollback into a draining variant (the
             # canary, or the old live of a new-arch promote): the
@@ -550,14 +618,17 @@ class ModelRegistry:
                 live = m.live
             if live is target:
                 raise
-            return live.scheduler.submit(image1, image2,
-                                         priority=priority, **kw)
+            return call(live.scheduler)
 
     def update_weights(self, variables, model: Optional[str] = None
                        ) -> None:
         """Direct live weight swap (the single-model API, per model) —
-        for rollouts WITH a bake period use deploy()/promote()."""
-        self._model(model).live.engine.update_weights(variables)
+        for rollouts WITH a bake period use deploy()/promote(). Routed
+        through the variant's scheduler so an armed feature cache
+        flushes with the swap."""
+        m = self._model(model)
+        live = m.live
+        live.scheduler.update_weights(variables)
 
     # -- observability -----------------------------------------------------
 

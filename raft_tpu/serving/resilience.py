@@ -217,7 +217,7 @@ class _DispatchJob:
     watchdog's clock)."""
 
     __slots__ = ("fn", "done", "error", "outcome", "bucket", "batch",
-                 "abandoned", "key", "t_start")
+                 "abandoned", "key", "t_start", "cached")
 
     def __init__(self, fn: Optional[Callable[["_DispatchJob"], None]]):
         self.fn = fn
@@ -229,6 +229,9 @@ class _DispatchJob:
         self.abandoned = False
         self.key: Optional[Tuple[int, int]] = None
         self.t_start: Optional[float] = None
+        #: feature-cache dispatch: a wedge verdict must drop the
+        #: CACHED executable for ``bucket``, not its plain sibling
+        self.cached = False
 
 
 class DispatchExecutor:

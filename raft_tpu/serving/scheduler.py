@@ -120,6 +120,8 @@ from typing import Deque, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from raft_tpu.ops.padding import pad_amounts
+from raft_tpu.serving.feature_cache import (FeatureCacheMiss,
+                                            FeatureCachePool)
 from raft_tpu.serving.futures import settle_future
 from raft_tpu.serving.metrics import ServingMetrics
 from raft_tpu.serving.resilience import (BREAKER_CLOSED, BREAKER_OPEN,
@@ -194,13 +196,15 @@ class ServeResult(NamedTuple):
 class _Request:
     __slots__ = ("image1", "image2", "key", "flow_init", "want_low",
                  "low_device", "future", "t_submit", "deadline",
-                 "priority")
+                 "priority", "stream", "seq", "prime")
 
     def __init__(self, image1, image2, key, flow_init, want_low,
-                 low_device, deadline, priority=None):
+                 low_device, deadline, priority=None, stream=None,
+                 seq=0, prime=False):
         self.image1 = image1
         self.image2 = image2
-        self.key = key                  # (H, W) — the coalescing group
+        self.key = key                  # (H, W) — the coalescing group;
+        #                                 (H, W, "cache") for cached rows
         self.flow_init = flow_init
         self.want_low = want_low
         self.low_device = low_device    # flow_low stays a device array
@@ -208,6 +212,12 @@ class _Request:
         self.t_submit = time.monotonic()
         self.deadline = deadline        # absolute monotonic, or None
         self.priority = priority        # interactive | batch | None
+        #: feature-cache stream identity + the session's frame counter
+        #: (slot validity is seq-exact); ``prime`` rows carry no pair —
+        #: their flow result is discarded, their cache output isn't
+        self.stream = stream
+        self.seq = seq
+        self.prime = prime
 
 
 class MicroBatchScheduler:
@@ -267,7 +277,19 @@ class MicroBatchScheduler:
                  interactive_weight: int = 4,
                  namespace: Optional[str] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 metrics_path: Optional[str] = None):
+                 metrics_path: Optional[str] = None,
+                 feature_cache: bool = False,
+                 feature_cache_capacity: int = 256):
+        """(Trailing knobs) ``feature_cache=True`` (needs a
+        ``RAFTEngine(feature_cache=True)``) arms the cross-frame
+        device feature-cache pool: ``submit_cached`` becomes
+        available, per-stream encoder state lives on device in a
+        ``feature_cache_capacity``-slot LRU pool
+        (serving/feature_cache), and warm video pairs dispatch
+        through the cached bucket signature — one encoder pass and
+        ONE frame of H2D per pair. Default OFF: no pool exists,
+        ``submit_cached`` raises, everything else is bitwise
+        unchanged."""
         self.engine = engine
         self.max_queue = int(max_queue)
         self.max_batch = int(max_batch)
@@ -288,6 +310,17 @@ class MicroBatchScheduler:
         self.namespace = namespace
         self.metrics = metrics or ServingMetrics(metrics_path,
                                                  namespace=namespace)
+        if feature_cache and not getattr(engine, "feature_cache", False):
+            raise ValueError(
+                "feature_cache=True needs an engine compiled with "
+                "feature_cache=True (the cached bucket signature)")
+        self._fcache = (FeatureCachePool(feature_cache_capacity)
+                        if feature_cache else None)
+        if self._fcache is not None:
+            # snapshots grow a per-bucket feature_cache block; the
+            # provider is read with NO metrics lock held (pool lock
+            # stays a leaf)
+            self.metrics.feature_cache_provider = self._fcache.snapshot
         self._cv = threading.Condition()
         self._q: Deque[_Request] = collections.deque()
         self._capacity: Dict[Tuple[int, int], int] = {}
@@ -411,6 +444,80 @@ class MicroBatchScheduler:
                         f"flow_init shape {tuple(flow_init.shape)} != "
                         f"{want} (1/8 of the ÷8-padded frame)")
         key = tuple(image1.shape[:2])
+        self._intake_guard(key)
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        req = _Request(image1, image2, key, flow_init, want_low,
+                       low_device, deadline, priority)
+        self._enqueue(req, priority)
+        return req.future
+
+    def submit_cached(self, frame, *, stream, seq: int,
+                      prime: bool = False,
+                      deadline_s: Optional[float] = None,
+                      priority: Optional[str] = None) -> Future:
+        """Enqueue ONE frame of a feature-cached video stream; returns
+        a Future resolving to :class:`ServeResult` (``flow_low`` is
+        always None — the recurrence state lives in the device pool).
+
+        ``stream`` is the pool slot identity; ``seq`` is the stream's
+        frame counter. ``prime=True`` submits the stream's (re)start
+        frame: the dispatch's flow output is discarded (the future
+        resolves to ``ServeResult(None, None)``) and its cache output
+        installs the slot — pair ``seq`` then correlates THIS frame
+        against a slot at ``seq - 1``. A pair submit with no valid
+        slot (never primed, LRU-evicted, flushed by a weight swap, or
+        a seq hole left by a failed/expired pair) fails fast with
+        :class:`~raft_tpu.serving.feature_cache.FeatureCacheMiss` —
+        the caller cold-restarts by re-priming
+        (``VideoSession(feature_cache=True)`` does this itself).
+
+        Raises the same intake errors as :meth:`submit`
+        (``BackpressureError``/``CircuitOpen``/``SchedulerClosed``;
+        cached rows get their own breaker per shape, labelled
+        ``HxW/cache``)."""
+        if self._fcache is None:
+            raise ValueError(
+                "submit_cached needs a feature_cache=True scheduler")
+        frame = np.asarray(frame)
+        if frame.dtype != self._wire_np:
+            frame = frame.astype(self._wire_np)
+        if frame.ndim != 3 or frame.shape[-1] != 3:
+            raise ValueError(
+                f"submit_cached takes one (H, W, 3) frame, got "
+                f"{frame.shape}")
+        if priority not in _PRIORITIES:
+            raise ValueError(
+                f"priority={priority!r}: choose "
+                f"{PRIORITY_INTERACTIVE!r}, {PRIORITY_BATCH!r} or None")
+        h, w = frame.shape[:2]
+        key = (h, w, "cache")
+        # closed/breaker checks BEFORE the pool probe: a closed (or
+        # draining) scheduler must say SchedulerClosed — the registry
+        # re-route catches that, while a spurious FeatureCacheMiss
+        # would send the session into a futile re-prime round trip
+        # against a dead variant (and mutate a flushed pool's counters)
+        self._intake_guard(key)
+        if not prime and not self._fcache.valid(stream, (h, w),
+                                                seq - 1):
+            # fail fast BEFORE the queue: a pair with no valid slot
+            # could only dispatch garbage — the miss is the caller's
+            # cold-restart signal, not a request failure
+            self._fcache.record_miss()
+            raise FeatureCacheMiss(
+                f"stream {stream!r} has no valid cache slot for "
+                f"{h}x{w} seq {seq - 1} (unprimed, evicted, flushed, "
+                "or a missed store) — re-prime the previous frame")
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        req = _Request(None, frame, key, None, False, False, deadline,
+                       priority, stream=stream, seq=int(seq),
+                       prime=prime)
+        self._enqueue(req, priority)
+        return req.future
+
+    def _intake_guard(self, key) -> None:
+        """Shared submit-time fail-fast checks (closed, open breaker)."""
         with self._cv:
             if self._closed:
                 # checked before the breaker: a closed scheduler must
@@ -428,10 +535,10 @@ class MicroBatchScheduler:
                 f"bucket {key} circuit open ({br.consecutive} "
                 "consecutive failures) — failing fast; retry after "
                 "backoff")
-        deadline = (time.monotonic() + deadline_s
-                    if deadline_s is not None else None)
-        req = _Request(image1, image2, key, flow_init, want_low,
-                       low_device, deadline, priority)
+
+    def _enqueue(self, req: _Request, priority: Optional[str]) -> None:
+        """Shared queue-insertion tail: expiry sweep, backpressure
+        (shed-batch-first for interactive arrivals), append + notify."""
         with self._cv:
             if self._closed:
                 raise SchedulerClosed("scheduler is closed")
@@ -476,20 +583,59 @@ class MicroBatchScheduler:
             self.metrics.record_submit(depth=len(self._q),
                                        priority=priority)
             self._cv.notify()
-        return req.future
 
     def update_weights(self, variables) -> None:
         """Live checkpoint swap; atomic wrt in-flight micro-batches
-        (the engine snapshots its tree once per dispatch)."""
+        (the engine snapshots its tree once per dispatch). With a
+        feature cache armed, the pool flushes — features computed by
+        the old tree must never feed the new one (the engine's
+        weights-version stamp is the backstop for the race window)."""
         self.engine.update_weights(variables)
+        if self._fcache is not None:
+            self.flush_feature_cache("weights_swap")
+
+    def invalidate_stream(self, stream) -> bool:
+        """Drop one stream's feature-cache slot (end-of-stream
+        hygiene — ``VideoSession.drain`` calls this so a finished
+        stream's device arrays stop occupying pool capacity). True if
+        a slot was dropped; no-op without a pool."""
+        if self._fcache is None:
+            return False
+        return self._fcache.invalidate(stream)
+
+    def flush_feature_cache(self, reason: str, **stamp) -> int:
+        """Drop every feature-cache slot and record a ``cache_flush``
+        event (stamped with ``reason`` + any caller fields — the
+        registry adds model/version). Returns how many slots dropped;
+        no-op (0) when no pool is armed."""
+        if self._fcache is None:
+            return 0
+        n = self._fcache.flush()
+        self.metrics.record_event("cache_flush", reason=reason,
+                                  slots=n, **stamp)
+        return n
 
     # -- breakers / health -------------------------------------------------
 
-    def _label(self, key: Tuple[int, int]) -> str:
+    #: label suffix marking feature-cache groups/buckets (a different
+    #: executable, a different failure domain than the plain program
+    #: at the same shape) — the one definition ``_key_label`` and the
+    #: cached dispatch's bucket label both use
+    CACHE_LABEL_SUFFIX = "/cache"
+
+    @classmethod
+    def _key_label(cls, key) -> str:
+        """Namespace-less label for a coalescing-group key: ``HxW``,
+        plus :attr:`CACHE_LABEL_SUFFIX` for feature-cache groups —
+        shared by ``_label`` and ``health()``."""
+        base = f"{key[0]}x{key[1]}"
+        return base + cls.CACHE_LABEL_SUFFIX if len(key) > 2 else base
+
+    def _label(self, key) -> str:
         """Breaker/event label for a request shape: ``model/HxW``
         under a registry namespace, plain ``HxW`` single-model — the
         per-model+bucket key the shared metrics.jsonl needs."""
-        base = f"{key[0]}x{key[1]}"
+        base = self._key_label(key)
         return f"{self.namespace}/{base}" if self.namespace else base
 
     def _breaker(self, key: Tuple[int, int]) -> Optional[CircuitBreaker]:
@@ -568,8 +714,8 @@ class MicroBatchScheduler:
         done = self._last_dispatch_done
         return {
             "state": self._health_state,
-            "buckets": {f"{h}x{w}": br.snapshot()
-                        for (h, w), br in sorted(breakers.items())},
+            "buckets": {self._key_label(k): br.snapshot()
+                        for k, br in sorted(breakers.items())},
             "worker_alive": self._worker.is_alive(),
             "dispatch_worker_alive": (self._exec.worker_alive()
                                       if self._exec else None),
@@ -590,19 +736,30 @@ class MicroBatchScheduler:
 
     # -- dispatch loop -----------------------------------------------------
 
-    def _shape_capacity(self, key: Tuple[int, int]) -> int:
+    def _shape_capacity(self, key) -> int:
         cap = self._capacity.get(key)
         if cap is None:
-            h, w = key
-            fit = self.engine.bucket_capacity(h, w)
-            if fit is None:
-                # no compiled bucket fits this spatial shape: pre-warm
-                # exactly one at max_batch so every later fill count
-                # batch-fills into it (executable count stays one per
-                # shape, the H3 discipline). After a wedge dropped the
-                # bucket, this is also the half-open probe's lazy
-                # recompile.
-                fit = self.engine.ensure_bucket(self.max_batch, h, w)[0]
+            h, w = key[0], key[1]
+            if len(key) > 2:
+                # feature-cache group: its own signature table — the
+                # plain kwarg-less calls below stay byte-identical for
+                # duck-typed engines without the cached API
+                fit = self.engine.bucket_capacity(h, w, cached=True)
+                if fit is None:
+                    fit = self.engine.ensure_bucket(self.max_batch,
+                                                    h, w,
+                                                    cached=True)[0]
+            else:
+                fit = self.engine.bucket_capacity(h, w)
+                if fit is None:
+                    # no compiled bucket fits this spatial shape:
+                    # pre-warm exactly one at max_batch so every later
+                    # fill count batch-fills into it (executable count
+                    # stays one per shape, the H3 discipline). After a
+                    # wedge dropped the bucket, this is also the
+                    # half-open probe's lazy recompile.
+                    fit = self.engine.ensure_bucket(self.max_batch,
+                                                    h, w)[0]
             cap = max(1, min(fit, self.max_batch))
             self._capacity[key] = cap
         return cap
@@ -849,7 +1006,10 @@ class MicroBatchScheduler:
             # engine recovery: the executable that hung is suspect —
             # drop it (and the cached capacity routed through it) so
             # the half-open probe recompiles from clean state
-            self.engine.drop_bucket(job.bucket)
+            if job.cached:
+                self.engine.drop_bucket(job.bucket, cached=True)
+            else:
+                self.engine.drop_bucket(job.bucket)
         self._capacity.pop(key, None)
         br = self._breaker(key)
         if br is not None:
@@ -896,7 +1056,12 @@ class MicroBatchScheduler:
         #                        results or record a breaker success
         label = self._label(key)
         if job.bucket is not None:
-            self.engine.drop_bucket(job.bucket)
+            if job.cached:
+                # the executable that hung is the CACHED program —
+                # indict it, not its plain sibling at the same shape
+                self.engine.drop_bucket(job.bucket, cached=True)
+            else:
+                self.engine.drop_bucket(job.bucket)
         self._capacity.pop(key, None)
         br = self._breaker(key)
         if br is not None:
@@ -988,7 +1153,10 @@ class MicroBatchScheduler:
                 batch, self._wedge_error(key)))
             return
         if batch:
-            self._dispatch(key, batch, job)
+            if len(key) > 2:
+                self._dispatch_cached(key, batch, job)
+            else:
+                self._dispatch(key, batch, job)
 
     def _assemble_flow_init(self, live: List[_Request], key):
         """The micro-batch's coalesced warm start, or None when every
@@ -1041,12 +1209,14 @@ class MicroBatchScheduler:
                 device_ms=(t_done - t_disp) * 1e3,
                 priority=r.priority)
 
-    def _complete_batch(self, key: Tuple[int, int], label: str,
-                        live: List[_Request], pending, t_disp: float,
-                        warm: bool, job: _DispatchJob) -> None:
-        """Completion stage (pipeline_depth > 1): the blocking fetch +
-        settle, off the dispatch path. Runs on the completion
-        executor's worker; a verdicted (abandoned) job settles nothing
+    def _run_completion(self, key, live: List[_Request], pending,
+                        job: _DispatchJob, settle) -> None:
+        """Completion-stage skeleton (pipeline_depth > 1), shared by
+        the plain and cached paths: the blocking fetch + settle off
+        the dispatch path, on the completion executor's worker.
+        ``settle(outs)`` is the ONLY mode-specific step — the
+        abandoned/breaker/accounting protocol must never diverge
+        between the two. A verdicted (abandoned) job settles nothing
         and records no breaker outcome."""
         # the watchdog clock restarts when the worker actually BEGINS
         # this job: queue-wait behind a slow-but-legal predecessor must
@@ -1079,7 +1249,7 @@ class MicroBatchScheduler:
                 if n:
                     self.metrics.record_failure(n)
                 return
-            self._settle(live, outs, label, t_disp, warm)
+            settle(outs)
             job.outcome = "ok"
             self._last_dispatch_done = time.monotonic()
             br = self._breaker(key)
@@ -1092,6 +1262,13 @@ class MicroBatchScheduler:
                     self._pending_jobs.remove(job)
                 except ValueError:
                     pass   # a wedge verdict removed it already
+
+    def _complete_batch(self, key: Tuple[int, int], label: str,
+                        live: List[_Request], pending, t_disp: float,
+                        warm: bool, job: _DispatchJob) -> None:
+        self._run_completion(
+            key, live, pending, job,
+            lambda outs: self._settle(live, outs, label, t_disp, warm))
 
     def _dispatch(self, key: Tuple[int, int], batch: List[_Request],
                   job: _DispatchJob) -> None:
@@ -1216,9 +1393,192 @@ class MicroBatchScheduler:
             self.metrics.record_failure(self._fail_requests(live, exc))
             job.outcome = "failed"
 
+    # -- feature-cache dispatch --------------------------------------------
+
+    def _settle_cached(self, key, live: List[_Request], outs,
+                       label: str, t_disp: float, lh: int, lw: int,
+                       ver: int) -> None:
+        """Resolve a finished CACHED micro-batch: install every row's
+        pool slot (fmap + speculative context + flow_low, sliced from
+        the full-bucket device outputs), THEN settle its future — a
+        session harvesting the future must find the slot present (the
+        sequential-harvest contract that makes the next pair warm).
+        Prime rows store a flow-less slot and resolve to
+        ``ServeResult(None, None)`` — their flow is refinement against
+        zero features, never surfaced."""
+        flow, low_full, fmap2, ctx2 = outs
+        hw = (key[0], key[1])
+        t_done = time.monotonic()
+        for i, r in enumerate(live):
+            # per-row slices are fresh device buffers computed from
+            # the call's OWNING outputs — the pool never holds a view
+            # of a donation target (the PR-10 discipline)
+            fl = None if r.prime else low_full[i, :lh, :lw]
+            self._fcache.store(r.stream, hw, r.seq, ver,
+                               fmap2[i, :lh, :lw], ctx2[i, :lh, :lw],
+                               fl)
+            res = ServeResult(None if r.prime else flow[i], None)
+            if not settle_future(r.future, res):
+                continue  # wedge verdict settled it first
+            self.metrics.record_complete(
+                label, queue_ms=(t_disp - r.t_submit) * 1e3,
+                device_ms=(t_done - t_disp) * 1e3,
+                priority=r.priority)
+
+    def _complete_cached(self, key, label: str, live: List[_Request],
+                         pending, t_disp: float, lh: int, lw: int,
+                         ver: int, job: _DispatchJob) -> None:
+        self._run_completion(
+            key, live, pending, job,
+            lambda outs: self._settle_cached(key, live, outs, label,
+                                             t_disp, lh, lw, ver))
+
+    def _dispatch_cached(self, key, batch: List[_Request],
+                         job: _DispatchJob) -> None:
+        """One feature-cached micro-batch: acquire every returning
+        row's pool slot (seq/geometry/weights-version exact — invalid
+        rows fail fast with ``FeatureCacheMiss``, they must not poison
+        the batch), warp each slot's ``flow_low`` into the row's
+        ``flow_init`` on device, and dispatch through the CACHED
+        bucket signature — one encoder pass, one frame of H2D per
+        row. Prime rows ride the same executable with zeroed cache
+        inputs."""
+        live: List[_Request] = []
+        for r in batch:
+            try:
+                running = r.future.set_running_or_notify_cancel()
+            except InvalidStateError:
+                continue  # wedge verdict settled it between take and here
+            if running:
+                live.append(r)
+            else:
+                self.metrics.record_cancelled()
+        if not live:
+            return
+        job.batch = live
+        job.cached = True
+        h, w = key[0], key[1]
+        left, right, top, bottom = pad_amounts(h, w)
+        lh = (h + top + bottom) // 8
+        lw = (w + left + right) // 8
+        t_disp = time.monotonic()
+        try:  # EVERYTHING here routes failures to the batch's futures
+            bucket = self.engine.route_bucket(len(live), h, w,
+                                              cached=True)
+            job.bucket = bucket
+            label = ("x".join(map(str, bucket))
+                     + self.CACHE_LABEL_SUFFIX)
+            fault_point("serve.request")
+            if job.abandoned:
+                self.metrics.record_failure(self._fail_requests(
+                    live, self._wedge_error(key)))
+                return
+            # slot acquisition at assembly time: the submit-time probe
+            # already failed obvious misses fast, but eviction/flush/
+            # swap can land while queued — those rows fail HERE with
+            # the cold-restart signal, and the rest of the batch
+            # serves. ``ver`` is the stamp the engine re-checks under
+            # its snapshot lock (StaleFeatureError on a raced swap).
+            ver = getattr(self.engine, "weights_version", 0)
+            # hoisted out of the per-row loop (ops.interp defers its
+            # own jax import; the scheduler stays lazy at module scope)
+            from raft_tpu.ops.interp import forward_interpolate_device
+            slots = []
+            kept: List[_Request] = []
+            missed: List[_Request] = []
+            for r in live:
+                if r.prime:
+                    kept.append(r)
+                    slots.append(None)
+                    continue
+                slot = self._fcache.acquire(r.stream, (h, w),
+                                            r.seq - 1, ver)
+                if slot is None:
+                    missed.append(r)
+                    continue
+                fi = None
+                if slot.flow_low is not None:
+                    # device-resident recurrence warm start: warp the
+                    # slot's flow_low on device (holes stay zero =
+                    # locally cold; a non-finite flow scatters nothing
+                    # — the poisoned-pair guard without a host sync)
+                    fi = forward_interpolate_device(slot.flow_low)
+                kept.append(r)
+                slots.append((slot.fmap, slot.ctx, fi))
+            if missed:
+                n = self._fail_requests(missed, FeatureCacheMiss(
+                    "cache slot invalidated while queued (evicted, "
+                    "flushed, or weights swapped) — re-prime the "
+                    "stream"))
+                self.metrics.record_failure(n)
+            if not kept:
+                # nothing reached the engine: a miss is pool churn,
+                # not an executable fault — no breaker outcome (a
+                # "failed" here would let cache churn open a healthy
+                # bucket's breaker), and no dispatch/occupancy record
+                # (nothing dispatched)
+                return
+            live = kept
+            job.batch = live
+            # recorded AFTER acquisition so occupancy counts the rows
+            # that actually reach the engine — queued-invalidation
+            # misses must not inflate the warm-video A/B numbers
+            with self._cv:
+                depth = len(self._q)
+            self.metrics.record_dispatch(label, filled=len(live),
+                                         capacity=bucket[0], depth=depth)
+            prev = self._prev_pending
+            overlapped = prev is not None and prev.t_ready is None
+            t_asm0 = time.monotonic()
+            i2 = np.stack([r.image2 for r in live])
+            pending = self.engine.infer_cached_async(
+                i2, slots, expect_version=ver)
+            t_call_end = time.monotonic()
+            gap_ms = None
+            if prev is not None:
+                gap_ms = (0.0 if prev.t_ready is None
+                          else max(0.0, (t_call_end - prev.t_ready)
+                                   * 1e3))
+            self.metrics.record_hot_path(
+                gap_ms=gap_ms, assembly_ms=(t_call_end - t_asm0) * 1e3,
+                overlapped=overlapped, h2d_bytes=pending.h2d_bytes,
+                requests=len(live))
+            self._prev_pending = pending
+            if job.abandoned:
+                n = self._fail_requests(live, self._wedge_error(key))
+                if n:
+                    self.metrics.record_failure(n)
+                return
+            if self._completion is None:
+                self._settle_cached(key, live, pending.fetch(), label,
+                                    t_disp, lh, lw, ver)
+                job.outcome = "ok"
+                return
+            cjob = _DispatchJob(
+                lambda j, key=key, label=label, live=live,
+                pending=pending, t_disp=t_disp, lh=lh, lw=lw, ver=ver:
+                self._complete_cached(key, label, live, pending,
+                                      t_disp, lh, lw, ver, j))
+            cjob.key = key
+            cjob.bucket = bucket
+            cjob.cached = True
+            cjob.batch = live
+            cjob.t_start = time.monotonic()
+            with self._pipe_lock:
+                self._pending_jobs.append(cjob)
+                self._completion.enqueue(cjob)
+            job.outcome = "dispatched"
+        except Exception as exc:  # route to the callers; worker survives
+            self.metrics.record_failure(self._fail_requests(live, exc))
+            job.outcome = "failed"
+
     # -- lifecycle ---------------------------------------------------------
 
     def executable_count(self) -> int:
+        count = getattr(self.engine, "executable_count", None)
+        if count is not None:
+            # RAFTEngine: plain + cached signature tables
+            return count()
         return len(self.engine._compiled)
 
     def write_metrics(self, path: Optional[str] = None) -> Dict:
@@ -1272,6 +1632,11 @@ class MicroBatchScheduler:
                 raise RuntimeError(
                     "completion stage failed to drain within "
                     f"{timeout}s")
+        if first and self._fcache is not None:
+            # retired variants keep their scheduler objects (frozen
+            # snapshots) — the pool must not pin per-stream device
+            # arrays past close
+            self.flush_feature_cache("close")
         if first and self.metrics.path:
             self.metrics.write_snapshot(
                 executables=self.executable_count())

@@ -54,6 +54,7 @@ class VideoSession:
 
     def __init__(self, scheduler, *, warm_start: bool = True,
                  device_state: bool = False,
+                 feature_cache: bool = False,
                  deadline_s: Optional[float] = None,
                  model: Optional[str] = None,
                  priority: Optional[str] = None,
@@ -86,6 +87,22 @@ class VideoSession:
         mid-stream. Against a plain scheduler all three stay unset and
         the submit call is byte-identical to before.
 
+        ``feature_cache=True`` (needs a scheduler/registry whose
+        engine and scheduler armed the cross-frame feature cache)
+        moves the WHOLE recurrence + encoder state device-side: each
+        frame submits alone through ``submit_cached`` (the pair's
+        first frame never re-ships or re-encodes — its features live
+        in the per-stream device pool), the stream's first frame (and
+        any cold restart) is a PRIME submit whose future the session
+        harvests internally, and a submit-time
+        ``FeatureCacheMiss`` (slot evicted/flushed/invalidated)
+        cold-restarts cleanly: re-prime the previous frame, wait it
+        out, resubmit the pair. ``warm_start``/``device_state`` are
+        superseded (state lives pool-side); ``drain()`` returns None
+        (the recurrence state never materializes to host). Rollout
+        moves (the ``variant_version`` poll) and shape changes
+        cold-restart BOTH the recurrence and the cache slot.
+
         ``retry_budget`` > 0 makes the session absorb transient
         submit-time rejections itself: a ``BackpressureError`` (full
         queue or registry admission budget) or ``CircuitOpen`` retries
@@ -102,6 +119,12 @@ class VideoSession:
         self._sched = scheduler
         self.warm_start = bool(warm_start)
         self.device_state = bool(device_state)
+        self.feature_cache = bool(feature_cache)
+        if feature_cache and not hasattr(scheduler, "submit_cached"):
+            raise ValueError(
+                "feature_cache=True needs a scheduler/ModelRegistry "
+                "with submit_cached (a feature_cache=True scheduler "
+                "over a feature_cache=True engine)")
         self.deadline_s = deadline_s
         self._variant_version: Optional[str] = None
         self._submit_kw = {}
@@ -141,6 +164,13 @@ class VideoSession:
         self._prev_frame: Optional[np.ndarray] = None
         self._pending = None                    # previous pair's Future
         self._flow_low: Optional[np.ndarray] = None
+        #: feature-cache stream identity: ALWAYS unique per session
+        #: object — pool slots are per-session recurrence state, and
+        #: two sessions sharing an explicit sticky ``route_key`` must
+        #: NOT share a slot (their independent frame counters would
+        #: collide on seq and silently correlate one video's frame
+        #: against the other's cached features)
+        self._stream = f"stream-{next(_SESSION_IDS)}"
 
     def _harvest(self) -> None:
         """Settle the previous pair — the recurrence is sequential per
@@ -162,6 +192,8 @@ class VideoSession:
         stream (or after a mid-stream resolution change, which
         restarts the recurrence: ``flow_low`` lives in the old frame
         geometry)."""
+        if self.feature_cache:
+            return self._submit_frame_cached(frame, deadline_s)
         frame = np.asarray(frame, np.float32)
         self.frames += 1
         prev, self._prev_frame = self._prev_frame, frame
@@ -170,21 +202,8 @@ class VideoSession:
         if prev.shape != frame.shape:
             self._pending, self._flow_low = None, None
             return None
-        if "route_key" in self._submit_kw:
-            # registry rollout guard: if this stream's variant changed
-            # since the last pair (deploy/promote/rollback moved its
-            # hash assignment, or a promote shipped new weights), the
-            # recurrence cold-restarts — warm-start state produced by
-            # one variant must never feed another model's refinement.
-            # (A change landing between this read and the submit is a
-            # one-pair race; the NEXT pair cold-restarts.)
-            ver = self._sched.variant_version(
-                self._submit_kw.get("model"),
-                self._submit_kw["route_key"])
-            if ver != self._variant_version:
-                if self._variant_version is not None:
-                    self._pending, self._flow_low = None, None
-                self._variant_version = ver
+        if self._variant_moved():
+            self._pending, self._flow_low = None, None
         flow_init = None
         if self.warm_start:
             self._harvest()
@@ -228,6 +247,136 @@ class VideoSession:
         self._pending = fut
         return fut
 
+    def _variant_moved(self) -> bool:
+        """Registry rollout guard (no-op off a registry): poll the
+        variant a request with this stream's sticky ``route_key``
+        would serve from; True when a deploy/promote/rollback moved it
+        since the last pair — warm state produced by one variant must
+        never feed another model's refinement, so the caller
+        cold-restarts. The first poll only establishes the baseline.
+        (A change landing between this read and the submit is a
+        one-pair race; the NEXT pair cold-restarts — and on the
+        feature-cache path the pool's weights-version stamp backstops
+        even that window.)"""
+        if "route_key" not in self._submit_kw:
+            return False
+        ver = self._sched.variant_version(
+            self._submit_kw.get("model"),
+            self._submit_kw["route_key"])
+        moved = (self._variant_version is not None
+                 and ver != self._variant_version)
+        self._variant_version = ver
+        return moved
+
+    def _harvest_cached(self) -> None:
+        """Settle the previous cached dispatch (pair or prime) — its
+        completion installs the pool slot the NEXT pair correlates
+        against, so the wait is what makes warmth knowable. A failure
+        already surfaced on that future, and the pool's seq-exact
+        validity turns its missed store into a clean submit-time miss:
+        nothing to reset here."""
+        if self._pending is None:
+            return
+        try:
+            self._pending.result()
+        except Exception:
+            pass
+        self._pending = None
+
+    def _submit_frame_cached(self, frame, deadline_s):
+        """The feature-cache form of ``submit_frame``: one frame ships
+        per submit; pairs correlate against the device pool's slot for
+        this stream. Cold starts (first frame, shape change, rollout
+        move) PRIME: the frame's features install the slot and the
+        caller gets None — exactly the first-frame contract."""
+        from raft_tpu.serving.feature_cache import FeatureCacheMiss
+
+        frame = np.asarray(frame, np.float32)
+        self.frames += 1
+        seq = self.frames
+        prev, self._prev_frame = self._prev_frame, frame
+        # the PR-9 rollout discipline, extended to encoder state: a
+        # deploy/promote/rollback that moves this stream's variant
+        # cold-restarts — the slot lives in the OLD variant's pool and
+        # its features in the old weights (the pool's weights-version
+        # stamp + StaleFeatureError backstop the race window)
+        cold = (prev is None or prev.shape != frame.shape
+                or self._variant_moved())
+        effective_deadline = (self.deadline_s if deadline_s is None
+                              else deadline_s)
+        if cold:
+            # stream (re)start: prime THIS frame — there is no pair
+            # (or the recurrence must restart in the new geometry/
+            # variant). Harvest the in-flight previous dispatch FIRST:
+            # its completion store must not land after (and clobber)
+            # the prime's fresh slot. The prime's own future is
+            # harvested before the next submit; the caller gets None.
+            self._harvest_cached()
+            self._pending = self._cached_submit(
+                frame, seq=seq, prime=True,
+                deadline_s=effective_deadline)
+            return None
+        # pair owed: wait out the previous dispatch — its completion
+        # installs this pair's first-frame features (the sequential-
+        # harvest contract: per-stream order, never serializing the
+        # device across streams)
+        self._harvest_cached()
+        fut = None
+        for attempt in range(3):
+            try:
+                fut = self._cached_submit(
+                    frame, seq=seq, prime=False,
+                    deadline_s=effective_deadline)
+                self.warm_submits += 1
+                break
+            except FeatureCacheMiss:
+                # slot gone (LRU-evicted, flushed by a weight swap, or
+                # a failed/expired pair left a seq hole): clean
+                # cold-restart — re-prime the pair's FIRST frame, wait
+                # it out, resubmit the pair against the fresh slot.
+                # One extra round trip, paid only on restarts. Bounded
+                # retries because under capacity starvation ANOTHER
+                # stream's store can evict the fresh slot between the
+                # re-prime and the resubmit probe; past the bound the
+                # miss surfaces — the pool genuinely is too small for
+                # the live stream population, and hammering would only
+                # deepen the churn. A failed re-prime surfaces its own
+                # error immediately.
+                if attempt == 2:
+                    raise
+                self._cached_submit(
+                    prev, seq=seq - 1, prime=True,
+                    deadline_s=effective_deadline).result()
+        self._pending = fut
+        return fut
+
+    def _cached_submit(self, frame, *, seq: int, prime: bool,
+                       deadline_s):
+        """One cached submit through the session's retry budget: a
+        transient ``BackpressureError``/``CircuitOpen`` retries with
+        jittered backoff up to the shared per-session cap, exhaustion
+        re-raises the ORIGINAL rejection — the cached analog of
+        ``_retry_submit``. No forced cold restart here: warmth is
+        decided pool-side at dispatch, and the slot's seq/version
+        validity already guards anything a backoff could stale."""
+        try:
+            return self._sched.submit_cached(
+                frame, stream=self._stream, seq=seq, prime=prime,
+                deadline_s=deadline_s, **self._submit_kw)
+        except self._retryable as exc:
+            delays = self._mk_delays()
+            while self.retries_used < self.retry_budget:
+                self.retries_used += 1
+                self._retry_sleep(next(delays))
+                try:
+                    return self._sched.submit_cached(
+                        frame, stream=self._stream, seq=seq,
+                        prime=prime, deadline_s=deadline_s,
+                        **self._submit_kw)
+                except self._retryable:
+                    continue
+            raise exc
+
     def _retry_submit(self, prev, frame,
                       deadline_s: Optional[float], original):
         """Absorb a retryable submit rejection within the session's
@@ -257,7 +406,21 @@ class VideoSession:
     def drain(self) -> Optional[np.ndarray]:
         """Wait out the last pair; returns the stream's final
         ``flow_low`` (None if the stream is cold) — always materialized
-        to host, whatever ``device_state`` says."""
+        to host, whatever ``device_state`` says. On the feature-cache
+        path it also releases the stream's pool slot (a finished
+        stream's device arrays must not occupy capacity live streams
+        need) and returns None (state never materialized to host)."""
+        if self.feature_cache:
+            self._harvest_cached()
+            inv = getattr(self._sched, "invalidate_stream", None)
+            if inv is not None:
+                if "route_key" in self._submit_kw:
+                    inv(self._stream,
+                        model=self._submit_kw.get("model"),
+                        route_key=self._submit_kw["route_key"])
+                else:
+                    inv(self._stream)
+            return None
         self._harvest()
         if self._flow_low is not None \
                 and not isinstance(self._flow_low, np.ndarray):

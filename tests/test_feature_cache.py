@@ -1,0 +1,646 @@
+"""Cross-frame device feature cache (PR 12): pool semantics, cached
+engine/scheduler/session plumbing, bitwise cached-vs-uncached parity,
+and the registry weight-swap flush drill.
+
+Parity note (pinned in TestEncoderBatchBits): XLA CPU conv bits move
+with the feature net's TOTAL batch size once it crosses the
+vectorization width (batch 1 == batch 2, 2 != 4). The uncached serve
+runs fnet at 2*bucket_batch, the cached serve at bucket_batch — so the
+bitwise cached-vs-uncached pin is exact at the bucket-batch-1 serving
+geometry (the steady-state single-stream case) on the BASIC model, and
+allclose-tight elsewhere.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import RAFT
+from raft_tpu.ops.interp import forward_interpolate_device
+from raft_tpu.serving.engine import RAFTEngine, StaleFeatureError
+from raft_tpu.serving.feature_cache import (FeatureCacheMiss,
+                                            FeatureCachePool)
+from raft_tpu.serving.scheduler import MicroBatchScheduler
+from raft_tpu.serving.session import VideoSession
+
+
+@pytest.fixture(scope="module")
+def small_setup():
+    cfg = RAFTConfig(small=True)
+    model = RAFT(cfg)
+    img = jnp.zeros((1, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+    return cfg, variables
+
+
+@pytest.fixture(scope="module")
+def small_cached_engine(small_setup):
+    """One shared feature-cache engine (small, 32x32, iters=1): the
+    scheduler/session tests reuse its compiles."""
+    cfg, variables = small_setup
+    return RAFTEngine(variables, cfg, iters=1, envelope=[(2, 32, 32)],
+                      precompile=True, warm_start=True,
+                      feature_cache=True)
+
+
+def _frames(rng, n, h=32, w=32):
+    return [rng.randint(0, 256, (h, w, 3)).astype(np.float32)
+            for _ in range(n)]
+
+
+class TestFeatureCachePool:
+    def test_store_acquire_roundtrip_and_counters(self):
+        pool = FeatureCachePool(capacity=4)
+        pool.store("s1", (32, 32), seq=1, version=0, fmap="F", ctx="C",
+                   flow_low="L")
+        assert pool.valid("s1", (32, 32), 1)
+        slot = pool.acquire("s1", (32, 32), 1, 0)
+        assert (slot.fmap, slot.ctx, slot.flow_low) == ("F", "C", "L")
+        snap = pool.snapshot()
+        assert snap["hits"] == 1 and snap["misses"] == 0
+        assert snap["stores"] == 1 and snap["occupancy"] == 1
+        assert snap["hit_rate"] == 1.0
+
+    @pytest.mark.parametrize("key,seq,version", [
+        ((48, 32), 1, 0),    # geometry change
+        ((32, 32), 2, 0),    # seq hole (missed store)
+        ((32, 32), 1, 1),    # weight swap
+    ])
+    def test_mismatch_drops_slot_and_counts_stale(self, key, seq,
+                                                  version):
+        pool = FeatureCachePool(capacity=4)
+        pool.store("s1", (32, 32), seq=1, version=0, fmap=1, ctx=2,
+                   flow_low=3)
+        assert pool.acquire("s1", key, seq, version) is None
+        snap = pool.snapshot()
+        assert snap["stale"] == 1 and snap["misses"] == 1
+        # the slot can never become valid again: it is GONE
+        assert snap["occupancy"] == 0
+        assert pool.acquire("s1", (32, 32), 1, 0) is None
+
+    def test_lru_eviction_order_and_capacity_bound(self):
+        pool = FeatureCachePool(capacity=2)
+        for i, s in enumerate(("a", "b", "c")):
+            pool.store(s, (32, 32), seq=1, version=0, fmap=i, ctx=i,
+                       flow_low=None)
+        snap = pool.snapshot()
+        assert snap["occupancy"] == 2 and snap["evictions"] == 1
+        assert not pool.valid("a", (32, 32), 1)      # oldest evicted
+        # touching "b" promotes it: "c" becomes LRU and dies next
+        assert pool.acquire("b", (32, 32), 1, 0) is not None
+        pool.store("d", (32, 32), seq=1, version=0, fmap=9, ctx=9,
+                   flow_low=None)
+        assert pool.valid("b", (32, 32), 1)
+        assert not pool.valid("c", (32, 32), 1)
+
+    def test_flush_and_invalidate(self):
+        pool = FeatureCachePool(capacity=4)
+        pool.store("a", (32, 32), 1, 0, 1, 1, None)
+        pool.store("b", (32, 32), 1, 0, 2, 2, None)
+        assert pool.invalidate("a") and not pool.invalidate("a")
+        assert pool.flush() == 1
+        snap = pool.snapshot()
+        assert snap["flushes"] == 1 and snap["occupancy"] == 0
+        assert len(pool) == 0
+
+    def test_record_miss_and_capacity_validation(self):
+        with pytest.raises(ValueError):
+            FeatureCachePool(capacity=0)
+        pool = FeatureCachePool(capacity=1)
+        pool.record_miss()
+        pool.record_miss(stale=True)
+        snap = pool.snapshot()
+        assert snap["misses"] == 2 and snap["stale"] == 1
+        assert snap["hit_rate"] == 0.0
+
+    def test_thread_safety_smoke(self):
+        pool = FeatureCachePool(capacity=8)
+        errs = []
+
+        def worker(wid):
+            try:
+                for i in range(200):
+                    pool.store(f"s{wid}", (32, 32), i, 0, i, i, None)
+                    pool.acquire(f"s{wid}", (32, 32), i, 0)
+            except Exception as exc:          # pragma: no cover
+                errs.append(exc)
+
+        ts = [threading.Thread(target=worker, args=(k,))
+              for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        assert len(pool) <= 8
+
+
+class TestEncoderBatchBits:
+    def test_fnet_bits_move_with_total_batch(self, small_setup):
+        """The parity pin's platform premise (see module docstring):
+        per-row encoder bits are batch-size-invariant from 1 to 2 but
+        not beyond — which is why the bitwise cached-vs-uncached pin
+        lives at bucket_batch=1 (uncached fnet batch 2 vs cached 1)."""
+        cfg = RAFTConfig()
+        model = RAFT(cfg)
+        rng = np.random.RandomState(0)
+        imgs = jnp.asarray(
+            rng.randint(0, 256, (4, 32, 32, 3)).astype(np.float32))
+        variables = model.init(jax.random.PRNGKey(0), imgs[:1],
+                               imgs[:1], iters=1)
+
+        def enc(v, x):
+            x = 2.0 * (x.astype(jnp.float32) / 255.0) - 1.0
+            return model.apply(
+                v, x, train=False, use_running_average=True,
+                method=lambda m, x, train, use_running_average:
+                m.fnet(x, train=train,
+                       use_running_average=use_running_average))
+
+        je = jax.jit(enc)
+        o1 = je(variables, imgs[:1])
+        o2 = je(variables, imgs[:2])
+        o4 = je(variables, imgs)
+        assert bool(jnp.all(o2[:1] == o1)), \
+            "batch 1 vs 2 drifted — the bb=1 bitwise pin just broke"
+        # informational premise: >2 is allowed to (and does) differ at
+        # fp32 noise; if THIS ever becomes bitwise too, the parity pin
+        # can extend to larger buckets
+        assert float(jnp.max(jnp.abs(o4[:1] - o1))) < 1e-4
+
+
+class TestCachedEngine:
+    def test_feature_cache_requires_warm_start(self, small_setup):
+        cfg, variables = small_setup
+        with pytest.raises(ValueError, match="warm_start"):
+            RAFTEngine(variables, cfg, feature_cache=True)
+
+    def test_prime_then_pair_one_cached_executable(
+            self, small_cached_engine, rng):
+        eng = small_cached_engine
+        f = _frames(rng if hasattr(rng, "randint")
+                    else np.random.RandomState(0), 3)
+        flow, low, fm, cn = eng.infer_cached(np.stack(f[:2]),
+                                             [None, None])
+        assert flow.shape == (2, 32, 32, 2)
+        assert isinstance(fm, jax.Array) and isinstance(cn, jax.Array)
+        lh, lw = 4, 4
+        slot = (fm[0, :lh, :lw], cn[0, :lh, :lw], None)
+        flow2, low2, _, _ = eng.infer_cached(np.stack(f[1:3]),
+                                             [slot, None])
+        assert np.isfinite(flow2).all()
+        assert len(eng._compiled_cached) == 1
+        assert eng.executable_count() == \
+            len(eng._compiled) + len(eng._compiled_cached)
+
+    def test_stale_version_guard(self, small_setup, rng):
+        cfg, variables = small_setup
+        eng = RAFTEngine(variables, cfg, iters=1,
+                         envelope=[(1, 32, 32)], precompile=True,
+                         warm_start=True, feature_cache=True)
+        f = _frames(np.random.RandomState(1), 2)
+        _, _, fm, cn = eng.infer_cached(f[0][None], [None])
+        eng.update_weights(variables)     # version 0 -> 1
+        with pytest.raises(StaleFeatureError):
+            eng.infer_cached(f[1][None],
+                             [(fm[0, :4, :4], cn[0, :4, :4], None)],
+                             expect_version=0)
+
+    def test_cache_outputs_survive_input_release(self, small_cached_engine):
+        """The PR-10 donated-alias regression, cached form: every
+        device output of a cached fetch aliases a DONATED input
+        buffer. What the caller must get are the call's OWNING result
+        arrays — still valid (correct bits) after the PendingBatch
+        released its input pins and fresh allocations churned the
+        allocator. Per-row pool slices must be fresh buffers, not
+        views of the full output."""
+        eng = small_cached_engine
+        rng = np.random.RandomState(2)
+        f = _frames(rng, 2)
+        _, _, fm_a, cn_a = eng.infer_cached(np.stack(f), [None, None])
+        ref_fm = np.asarray(fm_a)         # reference bits, copied out
+        row = fm_a[0, :4, :4]             # the pool's slice form
+        assert (row.unsafe_buffer_pointer()
+                != fm_a.unsafe_buffer_pointer())
+        # allocation pressure + more donating dispatches over the same
+        # executable: a use-after-donation would scribble these bits
+        junk = [np.ones((256, 1024), np.float32) for _ in range(8)]
+        for _ in range(3):
+            eng.infer_cached(np.stack(f), [None, None])
+        del junk
+        np.testing.assert_array_equal(np.asarray(fm_a), ref_fm)
+        np.testing.assert_array_equal(np.asarray(row), ref_fm[0, :4, :4])
+
+    def test_u8_wire_cached_bitwise_vs_f32_cached(self, small_setup):
+        """wire='u8' composes with the cached signature: uint8->f32 is
+        exact, so at integer inputs the cached u8 program is bitwise
+        the cached f32 program."""
+        cfg, variables = small_setup
+        rng = np.random.RandomState(3)
+        f = [rng.randint(0, 256, (1, 32, 32, 3)) for _ in range(2)]
+        outs = {}
+        for wire in ("f32", "u8"):
+            eng = RAFTEngine(variables, cfg, iters=1,
+                             envelope=[(1, 32, 32)], precompile=True,
+                             warm_start=True, wire=wire,
+                             feature_cache=True)
+            _, _, fm, cn = eng.infer_cached(
+                f[0].astype(np.uint8 if wire == "u8" else np.float32),
+                [None])
+            flow, _, _, _ = eng.infer_cached(
+                f[1].astype(np.uint8 if wire == "u8" else np.float32),
+                [(fm[0, :4, :4], cn[0, :4, :4], None)])
+            outs[wire] = flow
+        np.testing.assert_array_equal(outs["f32"], outs["u8"])
+
+
+class TestCachedParityBasic:
+    """Bitwise cached-vs-uncached at the bucket-batch-1 geometry,
+    BASIC model (the export arch), integer inputs — across cold,
+    warm, and evicted/re-primed rows."""
+
+    @pytest.fixture(scope="class")
+    def basic_engines(self):
+        cfg = RAFTConfig()
+        model = RAFT(cfg)
+        img = jnp.zeros((1, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), img, img,
+                               iters=1)
+        eng = RAFTEngine(variables, cfg, iters=2,
+                         envelope=[(1, 32, 32)], precompile=True,
+                         warm_start=True, feature_cache=True)
+        return cfg, eng
+
+    def test_bitwise_cold_warm_evicted(self, basic_engines):
+        cfg, eng = basic_engines
+        rng = np.random.RandomState(0)
+        f = _frames(rng, 4)
+        lh = lw = 4
+
+        # uncached reference chain: cold pair, warm pair (device
+        # warp — the same op the cached assembly uses), then a cold
+        # restart at (f2, f3)
+        ref1, rlow1 = eng.infer_batch(f[0][None], f[1][None],
+                                      return_low=True)
+        rwarm = forward_interpolate_device(jnp.asarray(rlow1[0]))[None]
+        ref2, rlow2 = eng.infer_batch(f[1][None], f[2][None],
+                                      flow_init=rwarm, return_low=True)
+        ref3 = eng.infer_batch(f[2][None], f[3][None])
+
+        # cached chain: prime f0; pair f1 (cold recurrence); warm pair
+        # f2; then "evicted" — re-prime f2 and serve (f2, f3) cold
+        _, _, fm0, cn0 = eng.infer_cached(f[0][None], [None])
+        c1, clow1, fm1, cn1 = eng.infer_cached(
+            f[1][None], [(fm0[0, :lh, :lw], cn0[0, :lh, :lw], None)])
+        np.testing.assert_array_equal(c1, ref1)
+        np.testing.assert_array_equal(np.asarray(clow1), rlow1)
+        cwarm = forward_interpolate_device(clow1[0, :lh, :lw])
+        c2, _, fm2, cn2 = eng.infer_cached(
+            f[2][None], [(fm1[0, :lh, :lw], cn1[0, :lh, :lw], cwarm)])
+        np.testing.assert_array_equal(c2, ref2)
+        _, _, fm2b, cn2b = eng.infer_cached(f[2][None], [None])
+        c3, _, _, _ = eng.infer_cached(
+            f[3][None], [(fm2b[0, :lh, :lw], cn2b[0, :lh, :lw], None)])
+        np.testing.assert_array_equal(c3, ref3)
+        # the whole drill rode exactly one cached + one plain program
+        assert len(eng._compiled_cached) == 1
+        assert len(eng._compiled) == 1
+
+
+class TestCachedScheduler:
+    def test_session_stream_warm_and_metrics_schema(
+            self, small_cached_engine, tmp_path):
+        eng = small_cached_engine
+        mpath = os.path.join(str(tmp_path), "metrics.jsonl")
+        sched = MicroBatchScheduler(eng, max_batch=2,
+                                    gather_window_s=0.0,
+                                    feature_cache=True,
+                                    feature_cache_capacity=4,
+                                    metrics_path=mpath)
+        sess = VideoSession(sched, feature_cache=True)
+        rng = np.random.RandomState(4)
+        futs = []
+        for fr in _frames(rng, 5):
+            fut = sess.submit_frame(fr)
+            if fut is not None:
+                futs.append(fut)
+        assert len(futs) == 4
+        for fut in futs:
+            res = fut.result(timeout=600)
+            assert res.flow.shape == (32, 32, 2)
+            assert res.flow_low is None   # state lives pool-side
+        assert sess.warm_submits == 4
+        snap = sched.metrics.snapshot(
+            executables=sched.executable_count())
+        fc = snap["feature_cache"]
+        assert {"capacity", "occupancy", "hits", "misses", "stale",
+                "evictions", "flushes", "stores",
+                "hit_rate"} <= set(fc)
+        assert fc["hit_rate"] == 1.0 and fc["misses"] == 0
+        assert fc["occupancy"] == 1
+        sched.close()
+        # close flushes (retired schedulers must not pin device state)
+        # and the event landed in the shared metrics.jsonl
+        events = [json.loads(ln) for ln in open(mpath)
+                  if "cache_flush" in ln]
+        assert events and events[-1]["reason"] == "close"
+        assert len(sched._fcache) == 0
+
+    def test_video_only_traffic_compiles_no_plain_bucket(
+            self, small_setup):
+        cfg, variables = small_setup
+        eng = RAFTEngine(variables, cfg, iters=1, warm_start=True,
+                         feature_cache=True)
+        with MicroBatchScheduler(eng, max_batch=2, gather_window_s=0.0,
+                                 feature_cache=True) as sched:
+            sess = VideoSession(sched, feature_cache=True)
+            rng = np.random.RandomState(5)
+            for fr in _frames(rng, 3):
+                fut = sess.submit_frame(fr)
+                if fut is not None:
+                    fut.result(timeout=600)
+        assert len(eng._compiled) == 0
+        assert len(eng._compiled_cached) == 1
+
+    def test_lru_churn_two_streams_capacity_one(self,
+                                                small_cached_engine):
+        """Capacity 1, two interleaved streams: every pair beyond the
+        first interleaving misses, re-primes, and still serves — the
+        capacity bound holds and degradation is churn, not failure."""
+        eng = small_cached_engine
+        with MicroBatchScheduler(eng, max_batch=2, gather_window_s=0.0,
+                                 feature_cache=True,
+                                 feature_cache_capacity=1) as sched:
+            a = VideoSession(sched, feature_cache=True)
+            b = VideoSession(sched, feature_cache=True)
+            rng = np.random.RandomState(6)
+            pairs = 0
+
+            def run(sess, n):
+                nonlocal pairs
+                for fr in _frames(rng, n):
+                    fut = sess.submit_frame(fr)
+                    if fut is not None:
+                        assert np.isfinite(
+                            fut.result(timeout=600).flow).all()
+                        pairs += 1
+
+            # phased interleave: each phase evicts the other stream's
+            # slot, so every stream switch is a miss -> re-prime ->
+            # serve round trip (deterministic — a TIGHT interleave can
+            # also fail a queued pair whose slot gets evicted before
+            # dispatch; that surfaces as FeatureCacheMiss on the
+            # future and the session re-primes, same contract)
+            run(a, 3)            # prime + 2 pairs, slot a
+            run(b, 2)            # prime + 1 pair, evicts a
+            run(a, 2)            # miss -> re-prime f2 -> 2 pairs
+            run(b, 1)            # miss -> re-prime -> 1 pair
+            snap = sched._fcache.snapshot()
+            assert pairs == 6
+            assert snap["evictions"] > 0
+            assert snap["misses"] >= 2        # the two stream switches
+            assert snap["occupancy"] <= 1
+
+    def test_failed_pair_leaves_seq_hole_then_recovers(
+            self, small_cached_engine):
+        """A failed pair stores nothing; the pool's seq-exact validity
+        turns that into a clean miss and the session re-primes — the
+        stream never correlates against the wrong frame's features."""
+        from raft_tpu.testing import faults
+
+        eng = small_cached_engine
+        with MicroBatchScheduler(eng, max_batch=2,
+                                 gather_window_s=0.0,
+                                 feature_cache=True) as sched:
+            sess = VideoSession(sched, feature_cache=True)
+            rng = np.random.RandomState(7)
+            f = _frames(rng, 4)
+            assert sess.submit_frame(f[0]) is None    # prime
+            fut1 = sess.submit_frame(f[1])
+            fut1.result(timeout=600)
+            # fail exactly the next micro-batch (the (f1, f2) pair)
+            faults.arm([{"site": "serve.request", "kind": "raise",
+                         "count": 1}])
+            try:
+                fut2 = sess.submit_frame(f[2])
+                with pytest.raises(Exception):
+                    fut2.result(timeout=600)
+            finally:
+                faults.disarm()
+            # pair (f2, f3): slot is at seq 2 (from fut1's store), the
+            # pair needs seq 3 -> miss -> session re-primes f2 and
+            # serves the pair; the stream self-heals
+            fut3 = sess.submit_frame(f[3])
+            assert np.isfinite(fut3.result(timeout=600).flow).all()
+            snap = sched._fcache.snapshot()
+            assert snap["misses"] >= 1
+
+    def test_weights_swap_flushes_and_stale_never_feeds(
+            self, small_setup, tmp_path):
+        """scheduler.update_weights: pool flushed + cache_flush event;
+        and a DIRECT engine swap (bypassing the flush broom) is caught
+        by the weights-version stamp — the queued pair fails with
+        FeatureCacheMiss instead of feeding old-weight features to the
+        new model, then the stream re-primes."""
+        cfg, variables = small_setup
+        eng = RAFTEngine(variables, cfg, iters=1,
+                         envelope=[(2, 32, 32)], precompile=True,
+                         warm_start=True, feature_cache=True)
+        mpath = os.path.join(str(tmp_path), "metrics.jsonl")
+        with MicroBatchScheduler(eng, max_batch=2,
+                                 gather_window_s=0.0,
+                                 feature_cache=True,
+                                 metrics_path=mpath) as sched:
+            sess = VideoSession(sched, feature_cache=True)
+            rng = np.random.RandomState(8)
+            f = _frames(rng, 6)
+            sess.submit_frame(f[0])
+            sess.submit_frame(f[1]).result(timeout=600)
+            # broom: scheduler-level swap flushes the pool
+            sched.update_weights(
+                jax.tree_util.tree_map(lambda p: p * 1.01, variables))
+            assert len(sched._fcache) == 0
+            events = [json.loads(ln) for ln in open(mpath)
+                      if "cache_flush" in ln]
+            assert events[-1]["reason"] == "weights_swap"
+            # session recovers: miss at submit -> re-prime -> pair
+            fut = sess.submit_frame(f[2])
+            assert np.isfinite(fut.result(timeout=600).flow).all()
+            # backstop: a DIRECT engine swap (no flush) — the stored
+            # slot's version stamp no longer matches, so the pair
+            # fails with the cold-restart signal, never stale-feeds
+            sess.submit_frame(f[3]).result(timeout=600)
+            eng.update_weights(
+                jax.tree_util.tree_map(lambda p: p * 1.02, variables))
+            fut = sess.submit_frame(f[4])
+            with pytest.raises(FeatureCacheMiss):
+                fut.result(timeout=600)
+            assert sched._fcache.snapshot()["stale"] >= 1
+            fut = sess.submit_frame(f[5])
+            assert np.isfinite(fut.result(timeout=600).flow).all()
+
+
+class TestSessionContracts:
+    def test_same_route_key_sessions_never_share_a_stream(
+            self, small_setup):
+        """Two sessions constructed with the SAME sticky route_key
+        must not share a pool slot: their independent frame counters
+        would collide on seq and silently correlate one video's frame
+        against the other's cached features (review-caught)."""
+        from raft_tpu.serving.registry import ModelRegistry
+
+        cfg, variables = small_setup
+        with ModelRegistry(max_batch=2, gather_window_s=0.0) as reg:
+            reg.add_model("m", variables, cfg, iters=1,
+                          envelope=[(2, 32, 32)], warm_start=True,
+                          feature_cache=True)
+            a = VideoSession(reg, model="m", feature_cache=True,
+                             route_key="user-42")
+            b = VideoSession(reg, model="m", feature_cache=True,
+                             route_key="user-42")
+            assert a._stream != b._stream
+            rng = np.random.RandomState(10)
+            fa, fb = _frames(rng, 2), _frames(rng, 2)
+            a.submit_frame(fa[0])
+            b.submit_frame(fb[0])
+            ra = a.submit_frame(fa[1]).result(timeout=600)
+            rb = b.submit_frame(fb[1]).result(timeout=600)
+            assert np.isfinite(ra.flow).all()
+            assert np.isfinite(rb.flow).all()
+            # the two streams' flows differ (each correlated against
+            # ITS OWN first frame, not a shared slot)
+            assert np.abs(ra.flow - rb.flow).max() > 0
+
+    def test_retry_budget_applies_to_cached_submits(self):
+        """The cached path honors the session retry budget: transient
+        BackpressureError absorbed through backoff, exhaustion
+        re-raises the ORIGINAL rejection (review-caught)."""
+        from concurrent.futures import Future
+
+        from raft_tpu.serving.scheduler import (BackpressureError,
+                                                ServeResult)
+
+        class StubSched:
+            def __init__(self, failures):
+                self.failures = failures
+                self.calls = 0
+
+            def submit_cached(self, frame, **kw):
+                self.calls += 1
+                if self.failures:
+                    self.failures -= 1
+                    raise BackpressureError("full")
+                fut = Future()
+                fut.set_result(ServeResult(None, None))
+                return fut
+
+        slept = []
+        sched = StubSched(failures=2)
+        sess = VideoSession(sched, feature_cache=True, retry_budget=3,
+                            retry_jitter=0.0,
+                            retry_sleep=slept.append)
+        assert sess.submit_frame(np.zeros((32, 32, 3))) is None
+        assert sched.calls == 3 and sess.retries_used == 2
+        assert len(slept) == 2
+        # exhaustion: more failures than remaining budget -> the
+        # ORIGINAL exception surfaces
+        sched2 = StubSched(failures=10)
+        sess2 = VideoSession(sched2, feature_cache=True,
+                             retry_budget=2, retry_jitter=0.0,
+                             retry_sleep=lambda _s: None)
+        with pytest.raises(BackpressureError):
+            sess2.submit_frame(np.zeros((32, 32, 3)))
+
+    def test_drain_releases_the_pool_slot(self, small_cached_engine):
+        """A finished stream must not occupy pool capacity: drain()
+        harvests the last dispatch, drops the slot, and returns None
+        (state never materializes to host on the cached path)."""
+        with MicroBatchScheduler(small_cached_engine, max_batch=2,
+                                 gather_window_s=0.0,
+                                 feature_cache=True) as sched:
+            sess = VideoSession(sched, feature_cache=True)
+            rng = np.random.RandomState(11)
+            for fr in _frames(rng, 3):
+                sess.submit_frame(fr)
+            assert sess.drain() is None
+            assert len(sched._fcache) == 0
+
+    def test_submit_cached_on_closed_scheduler_says_closed(
+            self, small_cached_engine):
+        """Closed-first ordering: a closed scheduler must raise
+        SchedulerClosed, never a spurious FeatureCacheMiss (the
+        registry re-route catches only the former; review-caught)."""
+        from raft_tpu.serving.scheduler import SchedulerClosed
+
+        sched = MicroBatchScheduler(small_cached_engine, max_batch=2,
+                                    gather_window_s=0.0,
+                                    feature_cache=True)
+        sched.close()
+        with pytest.raises(SchedulerClosed):
+            sched.submit_cached(np.zeros((32, 32, 3)), stream="s",
+                                seq=2)
+
+
+class TestRegistryFlushDrill:
+    def test_promote_flushes_and_stream_restarts_clean(
+            self, small_setup, tmp_path):
+        """The PR-9 variant_version regression, extended to encoder
+        state: a same-arch promote must flush the live pool (stamped
+        cache_flush event), the session must cold-restart, and the
+        post-promote pair must be BITWISE what a fresh stream under
+        the new weights computes — stale canary-era features never
+        feed the promoted model."""
+        from raft_tpu.serving.registry import ModelRegistry
+
+        cfg, variables = small_setup
+        v2 = jax.tree_util.tree_map(lambda p: p * 1.05, variables)
+        mpath = os.path.join(str(tmp_path), "metrics.jsonl")
+        rng = np.random.RandomState(9)
+        f = _frames(rng, 4)
+        with ModelRegistry(metrics_path=mpath, max_batch=2,
+                           gather_window_s=0.0) as reg:
+            reg.add_model("m", variables, cfg, iters=1,
+                          envelope=[(2, 32, 32)], warm_start=True,
+                          feature_cache=True)
+            sess = VideoSession(reg, model="m", feature_cache=True)
+            assert sess.submit_frame(f[0]) is None
+            sess.submit_frame(f[1]).result(timeout=600)
+            reg.deploy("m", v2, canary_fraction=0.01)
+            reg.promote("m")
+            events = [json.loads(ln) for ln in open(mpath)
+                      if "cache_flush" in ln]
+            assert any(e["reason"] == "promote" and e["model"] == "m"
+                       and e["version"] == "v2" for e in events)
+            # the session polls variant_version: the promote moved it,
+            # so the next frame cold-restarts (returns None, re-primes)
+            assert sess.submit_frame(f[2]) is None
+            got = sess.submit_frame(f[3]).result(timeout=600).flow
+            # reference: a FRESH stream under the promoted weights
+            fresh = VideoSession(reg, model="m", feature_cache=True)
+            assert fresh.submit_frame(f[2]) is None
+            want = fresh.submit_frame(f[3]).result(timeout=600).flow
+            np.testing.assert_array_equal(got, want)
+
+    def test_registry_cached_chaos_soak(self, small_setup):
+        """Chaos over the cached path: randomized raise/hang plans
+        with feature-cache sessions in flight — zero stranded, the
+        accounting identity, no leaked slots (bounded pool)."""
+        from raft_tpu.cli.serve_bench import run_chaos_drill
+
+        cfg, variables = small_setup
+        s = run_chaos_drill(variables, cfg, shapes=[(32, 32)],
+                            rounds=2, requests=8, submitters=2,
+                            bucket_batch=2, iters=1, sessions=2,
+                            session_frames=4, feature_cache=True,
+                            cache_capacity=4, recover_s=6.0, seed=3)
+        assert s["violations"] == []
+        assert s["executables"] == s["documented_buckets"]
+        for p in s["per_round"]:
+            assert p["cache_occupancy"] <= 4

@@ -127,6 +127,43 @@ def _build_serve_u8():
     return build
 
 
+def _build_serve_cached():
+    def build():
+        jax = ensure_cpu()
+        import jax.numpy as jnp
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.models import RAFT
+
+        cfg = RAFTConfig()
+        model = RAFT(cfg)
+        h, w = _IMAGE_HW
+        lh, lw = h // 8, w // 8
+        # the cross-frame cached serving recipe
+        # (RAFTEngine(feature_cache=True)): ONE frame of pixels plus
+        # the previous dispatch's device-resident features; all three
+        # cache inputs donated to their same-shaped cache outputs
+        # (fmap1->fmap2, cnet1->cnet2, flow_init->flow_low) — H4
+        # verifies XLA honors all three aliases
+        img = jax.ShapeDtypeStruct((1, h, w, 3), jnp.float32)
+        fmap = jax.ShapeDtypeStruct((1, lh, lw, cfg.fnet_dim),
+                                    jnp.float32)
+        ctx = jax.ShapeDtypeStruct((1, lh, lw, cfg.cnet_dim),
+                                   jnp.float32)
+        finit = jax.ShapeDtypeStruct((1, lh, lw, 2), jnp.float32)
+        variables = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, h, w, 3)),
+                               jnp.zeros((1, h, w, 3)), iters=1))
+
+        def serve_cached(variables, image2, fmap1, cnet1, flow_init):
+            return model.apply(variables, image2, fmap1, cnet1,
+                               flow_init, iters=_ITERS,
+                               method="forward_cached")
+
+        return serve_cached, (variables, img, fmap, ctx, finit)
+    return build
+
+
 # -- engine canaries ------------------------------------------------------
 
 _ENGINE_WEIGHTS = []   # [(variables, cfg)] — one real init, both canaries
@@ -249,6 +286,66 @@ def _build_engine_u8_wire():
             detail=f"u8-wire warm-start engine at {h}x{w}: uint8 "
                    "params pinned in the executable, bitwise parity "
                    "vs the f32 wire, warm round-trip",
+            hlo_texts=texts)
+    return build
+
+
+def _build_engine_feature_cache():
+    def build():
+        ensure_cpu()
+        import numpy as np
+        from raft_tpu.serving.engine import RAFTEngine
+        from raft_tpu.serving.scheduler import MicroBatchScheduler
+        from raft_tpu.serving.session import VideoSession
+
+        variables, cfg = _engine_weights()
+        h, w = _IMAGE_HW
+        # video-only deployment: the engine compiles NOTHING up front
+        # (no envelope) — the canary pins that a stream's whole
+        # lifecycle (cold prime -> warm pairs -> LRU-evicted ->
+        # re-primed -> warm again) runs through ONE cached executable
+        # per spatial shape, with no per-state compile forks and no
+        # stray plain-signature compiles
+        eng = RAFTEngine(variables, cfg, iters=_ITERS, warm_start=True,
+                         feature_cache=True)
+        rng = np.random.RandomState(0)
+        with MicroBatchScheduler(eng, max_batch=2, gather_window_s=0.0,
+                                 feature_cache=True,
+                                 feature_cache_capacity=1) as sched:
+            sess = VideoSession(sched, feature_cache=True)
+
+            def frame():
+                return rng.randint(0, 256, (h, w, 3)).astype(np.float32)
+
+            futs = [sess.submit_frame(frame()) for _ in range(4)]
+            for f in futs:
+                if f is not None:
+                    f.result(timeout=600)
+            # force an eviction: a second stream takes the capacity-1
+            # pool slot, then the first stream's next pair misses and
+            # cold-restarts (re-prime + pair) — same executable
+            other = VideoSession(sched, feature_cache=True)
+            for _ in range(3):
+                f = other.submit_frame(frame())
+                if f is not None:
+                    f.result(timeout=600)
+            evicted_before = sched._fcache.snapshot()["evictions"]
+            assert evicted_before > 0, \
+                "capacity-1 pool with two streams did not evict"
+            f = sess.submit_frame(frame())     # miss -> re-prime -> pair
+            assert f is not None and f.result(timeout=600).flow is not None
+            assert len(eng._compiled) == 0, \
+                "video-only traffic compiled a plain-signature bucket"
+            assert len(eng._compiled_cached) == 1, \
+                "cold->warm->evicted->warm forked cached executables"
+        texts = tuple(exe.as_text()
+                      for exe in eng._compiled_cached.values() if exe)
+        return CanaryResult(
+            observed_compiles=eng.executable_count(),
+            detail=f"feature-cache pool at {h}x{w}, capacity 1, two "
+                   "streams: cold->warm->evicted->re-primed->warm all "
+                   "through ONE cached executable (no per-state "
+                   "compile forks, no plain-signature strays)",
             hlo_texts=texts)
     return build
 
@@ -457,6 +554,29 @@ def build_targets() -> List[Target]:
             notes="u8-wire warm-start serving recipe "
                   "(RAFTEngine(wire='u8', warm_start=True)): uint8 "
                   "frames, on-device normalize, donated flow_init"),
+        Target(
+            name="serve_cached",
+            build=_build_serve_cached(),
+            donate_argnums=(2, 3, 4),   # fmap1 -> fmap2, cnet1 ->
+            #                             cnet2, flow_init -> flow_low:
+            #                             the per-stream cache recycles
+            #                             its own HBM every dispatch —
+            #                             H4 verifies XLA honors all
+            #                             three aliases
+            notes="cross-frame cached serving recipe "
+                  "(RAFTEngine(feature_cache=True)): one frame of "
+                  "pixels + donated device-resident cache rows"),
+        Target(
+            name="engine_feature_cache",
+            kind="canary",
+            build=_build_engine_feature_cache(),
+            expect_compiles=1,     # ONE cached executable per spatial
+            #                        shape across cold -> warm ->
+            #                        evicted -> warm (pool transitions
+            #                        are data, never new programs)
+            notes="feature-cache pool canary: stream lifecycle with a "
+                  "forced LRU eviction stays on one cached executable; "
+                  "no plain-signature strays in a video-only serve"),
         Target(
             name="engine_exact_ragged",
             kind="canary",

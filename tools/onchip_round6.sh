@@ -193,6 +193,33 @@ step serve_wire_r6 1800 python -m raft_tpu.cli.serve_bench \
     --wire u8 --pipeline-depth 2 --device-state \
     --log-dir /tmp/raft_serve_wire_r6
 
+# ---- cross-frame feature cache: warm-video A/B (PR 12) ---------------
+# same hot-path recipe + video-heavy traffic (long streams), A/B'd
+# against serve_wire_r6's configuration on the SAME session traffic:
+# the cached rung serves steady-state pairs with ONE encoder pass and
+# ONE frame of H2D each (warm_pairs_per_s / cache_hit_rate /
+# dispatch-gap in the summary line; hit_rate should sit >= 0.9 —
+# anything lower means the pool capacity is too small for the stream
+# population or streams are cold-restarting). Warm-up leg compiles the
+# cached-signature buckets (new programs) outside the measured window.
+step serve_cache_r6_base 1800 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 0 --submitters 1 \
+    --bucket-batch 4 --sessions 4 --session-frames 16 \
+    --deadline-ms 60000 --gather-ms 20 \
+    --wire u8 --pipeline-depth 2 --device-state \
+    --log-dir /tmp/raft_serve_cache_r6_base
+step serve_cache_r6_warm 1800 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 0 --submitters 1 \
+    --bucket-batch 4 --sessions 2 --session-frames 2 \
+    --deadline-ms 60000 --gather-ms 20 \
+    --wire u8 --pipeline-depth 2 --feature-cache
+step serve_cache_r6 1800 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 0 --submitters 1 \
+    --bucket-batch 4 --sessions 4 --session-frames 16 \
+    --deadline-ms 60000 --gather-ms 20 \
+    --wire u8 --pipeline-depth 2 --feature-cache \
+    --log-dir /tmp/raft_serve_cache_r6
+
 # ---- serving resilience: chaos drill against the real device (PR 7) --
 # randomized raise/hang plans at serve.request / serve.dispatch_exec /
 # engine.compile through the dispatch watchdog + per-bucket breakers +
