@@ -29,6 +29,16 @@ one-shot noise); run the SAME line with and without the flag for the
 A/B (``serve_cache_r6`` vs its ``_base`` leg in
 tools/onchip_round6.sh is that pair at real shapes).
 
+``--ragged`` (+ ``--capacity-classes HxW,...``) serves the SAME mixed
+traffic through ONE capacity-class executable instead of one bucket
+per distinct HxW: the ragged descriptor
+(kernels/corr_ragged_pallas) masks each row to its own extent and the
+scheduler coalesces across shapes. The summary grows
+``capacity_fill``/``cross_shape_coalesce_rate``/``padding_waste_ratio``
+and the A/B against the bucketed baseline is ``executables`` (O(1) vs
+O(shapes)) on identical traffic (``serve_ragged_r6`` vs
+``serve_bench_r6`` in tools/onchip_round6.sh).
+
 ``--chaos N`` instead runs N rounds of randomized fault plans
 (raise/hang at ``serve.request`` / ``serve.dispatch_exec`` /
 ``engine.compile``, seeded probabilities and nth-call scoping) through
@@ -121,6 +131,17 @@ def chaos_plan(rng: random.Random, hang_s: float = 0.5,
     return {"seed": rng.randrange(1 << 16), "faults": faults}
 
 
+def _capacity_envelope(shapes, capacity_classes, bucket_batch):
+    """The ragged engine's class list: the explicit ``--capacity-classes``
+    boxes, or one box covering every drill shape (the O(1)-compile
+    default the single-executable gate pins)."""
+    if capacity_classes:
+        return sorted({(bucket_batch, _ceil8(h), _ceil8(w))
+                       for h, w in capacity_classes})
+    return [(bucket_batch, max(_ceil8(h) for h, _ in shapes),
+             max(_ceil8(w) for _, w in shapes))]
+
+
 def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
               bucket_batch=4, iters=2, sessions=0, session_frames=4,
               deadline_s=None, max_queue=64, gather_window_s=0.005,
@@ -128,6 +149,7 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
               breaker_backoff_s=0.25, breaker_backoff_max_s=30.0,
               wire="f32", pipeline_depth=1, session_device_state=False,
               feature_cache=False, cache_capacity=256,
+              ragged=False, capacity_classes=None,
               fault_plan=None, recover_s=0.0,
               metrics_path=None, seed=0, engine=None):
     """The drill as a library call (tests reuse it, and may pass a
@@ -145,7 +167,15 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     slots) and runs every video session through it — the video-warm
     A/B: same traffic with the flag off is the baseline the
     ``warm_pairs_per_s``/``cache_hit_rate`` summary fields compare
-    against."""
+    against.
+
+    ``ragged=True`` compiles ONE capacity-class executable
+    (``capacity_classes`` boxes, default: one box covering every drill
+    shape) instead of one bucket per distinct HxW, and the scheduler
+    coalesces ACROSS shapes into it — the A/B against the same traffic
+    without the flag compares ``executables`` (O(1) vs O(shapes)),
+    ``capacity_fill``, ``cross_shape_coalesce_rate`` and
+    ``padding_waste_ratio``."""
     import numpy as np
 
     from raft_tpu.serving.engine import RAFTEngine
@@ -157,14 +187,27 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     from raft_tpu.serving.session import VideoSession
     from raft_tpu.testing import faults
 
+    if ragged and feature_cache:
+        raise ValueError("--ragged with --feature-cache is not "
+                         "supported yet (the cached signature keeps "
+                         "per-shape buckets)")
     if engine is None:
-        # one documented bucket per distinct ÷8-padded request shape
-        envelope = sorted({(bucket_batch, _ceil8(h), _ceil8(w))
-                           for h, w in shapes})
-        engine = RAFTEngine(variables, cfg, iters=iters,
-                            envelope=envelope, precompile=True,
-                            warm_start=True, wire=wire,
-                            feature_cache=feature_cache)
+        if ragged:
+            # ONE documented executable per capacity class — the
+            # whole mixed-shape drill rides it
+            engine = RAFTEngine(
+                variables, cfg, iters=iters, precompile=True,
+                warm_start=True, wire=wire, ragged=True,
+                capacity_classes=_capacity_envelope(
+                    shapes, capacity_classes, bucket_batch))
+        else:
+            # one documented bucket per distinct ÷8-padded request shape
+            envelope = sorted({(bucket_batch, _ceil8(h), _ceil8(w))
+                               for h, w in shapes})
+            engine = RAFTEngine(variables, cfg, iters=iters,
+                                envelope=envelope, precompile=True,
+                                warm_start=True, wire=wire,
+                                feature_cache=feature_cache)
     _n_exec = getattr(engine, "executable_count",
                       lambda: len(engine._compiled))
     documented = _n_exec()
@@ -179,6 +222,7 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                                 pipeline_depth=pipeline_depth,
                                 feature_cache=feature_cache,
                                 feature_cache_capacity=cache_capacity,
+                                ragged=ragged,
                                 metrics_path=metrics_path)
     if feature_cache and sessions:
         # compile-outside-the-measurement discipline (the engine's
@@ -308,6 +352,8 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     rec = sched.metrics.snapshot(executables=_n_exec())
     total_served = served + session_stats["pairs"]
     occ = rec["occupancy"]
+    rag = rec["ragged"]
+    waste = rec["padding_waste"]
     accounted = (rec["completed"] + rec["failed"]
                  + rec["deadline_missed"] + rec["cancelled"])
     open_buckets = sum(1 for b in health["buckets"].values()
@@ -334,6 +380,13 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
         "documented_buckets": documented,
         "mean_occupancy": occ["mean"],
         "baseline_occupancy": occ["one_per_dispatch_baseline"],
+        # ragged A/B surface: ONE executable per capacity class vs one
+        # per shape, box fill, how often a dispatch mixed shapes, and
+        # the padding waste both paths report comparably
+        "ragged": bool(ragged),
+        "capacity_fill": rag["capacity_fill"],
+        "cross_shape_coalesce_rate": rag["cross_shape_coalesce_rate"],
+        "padding_waste_ratio": waste["waste_ratio"],
         "session_pairs": session_stats["pairs"],
         "warm_submits": session_stats["warm"],
         "recovery_probes": recovery["probes"],
@@ -393,6 +446,7 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                     wire="f32", pipeline_depth=1, sessions=0,
                     session_frames=4, session_device_state=False,
                     feature_cache=False, cache_capacity=256,
+                    ragged=False, capacity_classes=None,
                     deadline_s=None, seed=0, metrics_path=None,
                     engine=None):
     """``rounds`` randomized fault rounds + one clean recovery round
@@ -403,17 +457,48 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
     The engine compiles ``exact_shapes=True`` so recovery is honest:
     a dropped bucket must recompile (it can't hide behind a spatially
     larger healthy bucket), pinning the documented executable count
-    after the final clean round."""
+    after the final clean round. With ``ragged=True`` the wedge/drop/
+    recompile cycle runs against the capacity-class table instead —
+    the chaos passthrough the ragged path must survive unchanged."""
     from raft_tpu.serving.engine import RAFTEngine
 
     rng = random.Random(seed)
     if engine is None:
-        envelope = sorted({(bucket_batch, _ceil8(h), _ceil8(w))
-                           for h, w in shapes})
-        engine = RAFTEngine(variables, cfg, iters=iters,
-                            envelope=envelope, precompile=True,
-                            warm_start=True, exact_shapes=True,
-                            wire=wire, feature_cache=feature_cache)
+        if ragged:
+            classes = _capacity_envelope(shapes, capacity_classes,
+                                         bucket_batch)
+            if len(classes) > 1:
+                # the recovery pin (executables == documented after
+                # the clean round) needs every wedge-dropped class to
+                # honestly recompile — with a spatially larger sibling
+                # class, dropped-class traffic re-routes there and the
+                # drop never restores (the ragged analog of why the
+                # bucketed chaos branch forces exact_shapes=True)
+                raise ValueError(
+                    "--chaos --ragged needs a SINGLE capacity class "
+                    "(the default one-covering-box, or one explicit "
+                    f"--capacity-classes entry); got {classes}")
+            cmax = classes[0]
+            bad = [s for s in shapes if _ceil8(s[0]) > cmax[1]
+                   or _ceil8(s[1]) > cmax[2]]
+            if bad:
+                # a shape outside the class would compile-on-miss a
+                # new box AFTER the documented-count snapshot, failing
+                # the same pin from the other direction
+                raise ValueError(
+                    f"--chaos --ragged: shapes {bad} exceed the "
+                    f"capacity class {cmax}")
+            engine = RAFTEngine(
+                variables, cfg, iters=iters, precompile=True,
+                warm_start=True, wire=wire, ragged=True,
+                capacity_classes=classes)
+        else:
+            envelope = sorted({(bucket_batch, _ceil8(h), _ceil8(w))
+                               for h, w in shapes})
+            engine = RAFTEngine(variables, cfg, iters=iters,
+                                envelope=envelope, precompile=True,
+                                warm_start=True, exact_shapes=True,
+                                wire=wire, feature_cache=feature_cache)
     _n_exec = getattr(engine, "executable_count",
                       lambda: len(engine._compiled))
     documented = _n_exec()
@@ -432,6 +517,7 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                   session_device_state=session_device_state,
                   feature_cache=feature_cache,
                   cache_capacity=cache_capacity,
+                  ragged=ragged, capacity_classes=capacity_classes,
                   recover_s=recover_s, metrics_path=metrics_path,
                   engine=engine)
     sites = (CHAOS_SITES_PIPELINED if pipeline_depth > 1
@@ -1126,6 +1212,19 @@ def main(argv=None):
     p.add_argument("--cache-capacity", type=int, default=256,
                    help="feature-cache pool slots (LRU beyond; the "
                         "per-stream device-memory bound)")
+    p.add_argument("--ragged", action="store_true",
+                   help="serve every shape through ONE capacity-class "
+                        "executable (ragged descriptor, masked-tail "
+                        "correlation) and coalesce micro-batches "
+                        "ACROSS shapes; the summary's capacity_fill / "
+                        "cross_shape_coalesce_rate / executables are "
+                        "the A/B against the same traffic without the "
+                        "flag")
+    p.add_argument("--capacity-classes", default=None, metavar="HxW,...",
+                   help="with --ragged: explicit capacity-class boxes "
+                        "(each compiled at --bucket-batch rows); "
+                        "default is one box covering every --shapes "
+                        "entry")
     p.add_argument("--models", default=None,
                    help="comma list of arch names (basic|small) to "
                         "serve as independent live models behind a "
@@ -1185,6 +1284,15 @@ def main(argv=None):
 
     shapes = [tuple(int(v) for v in s.split("x"))
               for s in args.shapes.split(",")]
+    capacity_classes = None
+    if args.capacity_classes:
+        capacity_classes = [tuple(int(v) for v in s.split("x"))
+                            for s in args.capacity_classes.split(",")]
+    if capacity_classes and not args.ragged:
+        raise SystemExit("--capacity-classes needs --ragged")
+    if args.ragged and args.models:
+        raise SystemExit("--ragged is a single-model drill knob (the "
+                         "registry rungs keep the bucketed path)")
     metrics_path = (os.path.join(args.log_dir, "metrics.jsonl")
                     if args.log_dir else None)
     if (args.guardian or args.admission_budget) and not args.models:
@@ -1313,6 +1421,7 @@ def main(argv=None):
             session_device_state=args.device_state,
             feature_cache=args.feature_cache,
             cache_capacity=args.cache_capacity,
+            ragged=args.ragged, capacity_classes=capacity_classes,
             max_queue=args.queue, seed=args.seed,
             metrics_path=metrics_path)
         print(json.dumps(summary), flush=True)
@@ -1336,6 +1445,7 @@ def main(argv=None):
         session_device_state=args.device_state,
         feature_cache=args.feature_cache,
         cache_capacity=args.cache_capacity,
+        ragged=args.ragged, capacity_classes=capacity_classes,
         recover_s=args.recover_s,
         metrics_path=metrics_path, seed=args.seed)
     print(json.dumps(summary), flush=True)

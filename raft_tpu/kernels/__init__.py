@@ -10,9 +10,16 @@ from raft_tpu.kernels.corr_alt_pallas import (alt_corr_lookup_pallas,
                                               pad_f2_pyramid)
 from raft_tpu.kernels.corr_pallas import (corr_lookup_pallas, pad_pyramid,
                                           pallas_available)
+from raft_tpu.kernels.corr_ragged_pallas import (RaggedDescriptor,
+                                                 build_corr_pyramid_ragged,
+                                                 corr_lookup_ragged,
+                                                 make_descriptor,
+                                                 mask_features)
 from raft_tpu.kernels.gru_pallas import (gru_blend, gru_cell_lane_major,
                                          gru_gates)
 
-__all__ = ["alt_corr_lookup_pallas", "corr_lookup_pallas", "gru_blend",
-           "gru_cell_lane_major", "gru_gates", "pad_f2_pyramid",
-           "pad_pyramid", "pallas_available"]
+__all__ = ["RaggedDescriptor", "alt_corr_lookup_pallas",
+           "build_corr_pyramid_ragged", "corr_lookup_pallas",
+           "corr_lookup_ragged", "gru_blend", "gru_cell_lane_major",
+           "gru_gates", "make_descriptor", "mask_features",
+           "pad_f2_pyramid", "pad_pyramid", "pallas_available"]

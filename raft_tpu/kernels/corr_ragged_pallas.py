@@ -1,0 +1,165 @@
+"""Ragged-batch correlation: one program for mixed spatial shapes.
+
+Sibling of ``corr_pallas.py`` for the ragged serving path (the TPU
+lesson of *Ragged Paged Attention*, arXiv 2604.15464: ONE compiled
+program walks a per-row batch descriptor instead of compiling per
+shape). A ragged micro-batch packs requests of DIFFERENT ``(h, w)``
+into one ``(B, Hcap, Wcap)`` capacity box; each row's descriptor says
+how much of the box is real. The PR-2 lane-major ``(B, H·W, C)``
+layout already made the correlation hot loops shape-agnostic in H·W —
+this module adds the one missing piece, the per-row validity mask, and
+the key observation that makes the LOOKUP kernels ragged for free:
+
+**Self-masking.** Every lookup backend in ``models/corr.py`` (and the
+Mosaic kernel in ``corr_pallas.py``) implements grid_sample's
+``padding_mode='zeros'``: window taps outside the volume read zeros.
+So once the per-row feature tails are zeroed (``mask_features``), the
+correlation volume of row *i* is EXACTLY the row's own
+``(h_i/8, w_i/8)`` volume zero-padded to the capacity box, and any
+window that drifts past the row's valid extent reads the same zeros an
+out-of-bounds tap would have read on the row's own volume. No new
+gather kernel is needed — the ragged path rides the SAME measured
+kernels (onehot/softsel/pallas, each with its own interpret-mode CPU
+fallback), which is why this file carries masks and descriptors, not a
+second Mosaic lookup. ``tests/test_ragged.py`` pins the equivalence
+bitwise at pyramid level 0 (and across all levels at pool-aligned
+extents).
+
+Masked-tail semantics, precisely:
+
+- target pixels past a row's valid extent contribute NOTHING to any
+  query's window (their correlation entries are exactly 0.0);
+- query pixels past the valid extent produce garbage rows that the
+  serving layer crops away (they never ship to a caller);
+- a full-extent row's mask is the identity (``jnp.where`` on an
+  all-true mask returns the operand's exact bits), so a request whose
+  padded shape equals the capacity box is BITWISE the bucketed path —
+  the oracle pin the serving tests hold the ragged engine to.
+
+The descriptor also carries the flat-view bookkeeping the ISSUE's
+``(B, HW_cap, C)`` form names (``hw_offset``/``valid_len``): row *i*
+of the flattened buffer starts at ``i * Hcap * Wcap`` and its first
+``h8_i * Wcap`` lanes hold the row-major valid plane — the occupancy
+accounting the scheduler's capacity-fill gauge reports.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RaggedDescriptor(NamedTuple):
+    """Per-row validity of one ragged micro-batch at 1/8 feature
+    resolution, inside a shared ``(Hcap/8, Wcap/8)`` capacity box.
+
+    ``h8``/``w8``: (B,) int32 valid extents (``hp_i/8``, ``wp_i/8`` of
+    the row's ÷8-padded request; 0 for batch-fill rows, which masks the
+    whole row — padded rows contribute nothing).
+    ``hw_offset``/``valid_len``: (B,) int32 flat-view bookkeeping —
+    where row *i* starts in the flattened ``(B·HW_cap,)`` lane order
+    and how many of its ``HW_cap`` entries are real.
+    """
+
+    h8: jax.Array
+    w8: jax.Array
+    hw_offset: jax.Array
+    valid_len: jax.Array
+
+
+def make_descriptor(shapes8: Sequence[Tuple[int, int]],
+                    cap_hw8: Tuple[int, int],
+                    batch: int) -> RaggedDescriptor:
+    """Build the descriptor for ``len(shapes8)`` real rows padded to
+    ``batch`` total rows of a ``cap_hw8 = (Hcap/8, Wcap/8)`` box.
+
+    ``shapes8``: per-row valid (h8, w8); every extent must fit the box
+    (raising here beats an out-of-range mask silently zeroing a real
+    request's features).
+    """
+    ch, cw = cap_hw8
+    if len(shapes8) > batch:
+        raise ValueError(f"{len(shapes8)} rows > batch {batch}")
+    h8 = [0] * batch
+    w8 = [0] * batch
+    for i, (h, w) in enumerate(shapes8):
+        if h > ch or w > cw:
+            raise ValueError(
+                f"row {i} extent ({h}, {w}) exceeds the capacity box "
+                f"({ch}, {cw})")
+        h8[i], w8[i] = int(h), int(w)
+    hw = ch * cw
+    return RaggedDescriptor(
+        h8=jnp.asarray(h8, jnp.int32),
+        w8=jnp.asarray(w8, jnp.int32),
+        hw_offset=jnp.asarray([i * hw for i in range(batch)], jnp.int32),
+        valid_len=jnp.asarray([h8[i] * cw for i in range(batch)],
+                              jnp.int32))
+
+
+def mask_features(fmap: jax.Array, valid_h: jax.Array,
+                  valid_w: jax.Array) -> jax.Array:
+    """Zero a (B, H, W, C) feature map past each row's valid extent.
+
+    ``valid_h``/``valid_w``: (B,) int32. Pure vectorized select against
+    broadcasted iotas — shape-agnostic in (H, W), lane-clean in C, and
+    cheap enough that XLA fuses it into the producing conv's epilogue
+    (no Mosaic kernel warranted; measured as noise next to the
+    all-pairs GEMM it feeds). The select is EXACT: an all-true mask
+    returns the operand's bits unchanged — the identity the full-extent
+    bitwise parity pin rests on.
+    """
+    B, H, W, _ = fmap.shape
+    ih = jax.lax.broadcasted_iota(jnp.int32, (B, H, W), 1)
+    iw = jax.lax.broadcasted_iota(jnp.int32, (B, H, W), 2)
+    valid = ((ih < valid_h[:, None, None])
+             & (iw < valid_w[:, None, None]))
+    return jnp.where(valid[..., None], fmap, jnp.zeros((), fmap.dtype))
+
+
+def build_corr_pyramid_ragged(fmap1: jax.Array, fmap2: jax.Array,
+                              valid_h: jax.Array, valid_w: jax.Array,
+                              num_levels: int = 4):
+    """Masked all-pairs pyramid: each row's volume is its own smaller
+    volume zero-embedded in the capacity box.
+
+    Masking BOTH maps makes tail targets contribute exact zeros to
+    every window (fmap2) and tail queries produce zero rows (fmap1 —
+    cropped by the serving layer either way). Pyramid levels pool the
+    box; a row's valid extent at level l is its extent/2^l, and pooled
+    cells straddling the valid boundary average real values against
+    zeros — the zero-padding semantics of the row's own volume embedded
+    in the box (exactly the plain pyramid's behavior at ITS boundary).
+    """
+    from raft_tpu.models.corr import build_corr_pyramid
+
+    return build_corr_pyramid(mask_features(fmap1, valid_h, valid_w),
+                              mask_features(fmap2, valid_h, valid_w),
+                              num_levels)
+
+
+def corr_lookup_ragged(pyramid, coords: jax.Array, radius: int,
+                       impl: str = "gather") -> jax.Array:
+    """Window lookup over a MASKED pyramid — ragged by self-masking.
+
+    Every backend already implements zeros-outside-the-volume, and the
+    masked volume is zero outside each row's valid extent, so the plain
+    lookups ARE the ragged lookups: a window drifting past a row's
+    boundary reads the same zeros in the capacity box that it would
+    have read out-of-bounds on the row's own volume.
+    ``impl='pallas'`` routes through the Mosaic kernel
+    (``corr_pallas``), inheriting its interpret-mode CPU fallback; the
+    XLA backends need no fallback at all.
+    """
+    if impl == "pallas":
+        from raft_tpu.kernels.corr_pallas import corr_lookup_pallas
+
+        return corr_lookup_pallas(pyramid, coords, radius)
+    from raft_tpu.models.corr import (corr_lookup, corr_lookup_onehot,
+                                      corr_lookup_softsel)
+
+    fn = {"gather": corr_lookup, "onehot": corr_lookup_onehot,
+          "softsel": corr_lookup_softsel}[impl]
+    return fn(pyramid, coords, radius)

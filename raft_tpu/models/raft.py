@@ -263,6 +263,67 @@ class RAFT(nn.Module):
         return self._refine(corr_state, lookup, net, inp, B, H, W,
                             iters, flow_init, test_mode, raw_predictions)
 
+    def forward_ragged(self, image1, image2, valid_h8, valid_w8,
+                       flow_init: Optional[jax.Array] = None,
+                       iters: int = 12):
+        """Ragged serving: ONE program for mixed spatial shapes.
+
+        A ragged micro-batch packs requests of different ``(h, w)``
+        into one ``(B, Hcap, Wcap)`` capacity box (each row edge-padded
+        to its own ÷8 alignment, then zero-filled); ``valid_h8`` /
+        ``valid_w8`` are (B,) int32 per-row valid extents at 1/8
+        resolution — the ragged descriptor of arXiv 2604.15464, carried
+        as TRACED arguments so every extent mix runs the same compiled
+        program. The encoders run over the whole box (convolutions need
+        the box's spatial structure); the correlation path then applies
+        masked-tail semantics (kernels/corr_ragged_pallas): features
+        past each row's valid extent are zeroed, so a row's correlation
+        volume is exactly its own smaller volume zero-embedded in the
+        box, and every lookup backend's zeros-outside semantics makes
+        the per-iteration window gather ragged for free — the
+        descriptor rides the scanned refinement loop inside the masked
+        ``corr_state`` the GRU body's lookup closes over.
+
+        Returns the test-mode ``(flow_low, flow_up)`` pair at the box
+        geometry; the serving layer crops each row to its request.
+
+        Bitwise note: a FULL-extent row (valid extents == the box) is
+        masked by an all-true select — exact identity — so its outputs
+        are bitwise what ``__call__`` computes on the same padded batch
+        (the ragged-vs-bucketed oracle pin, tests/test_ragged.py). A
+        sub-capacity row instead gets the masked zeros-tail semantics:
+        cleaner than the bucketed path's fill-feature correlations, but
+        a different program than exact-shape compilation — the box
+        fill still shifts the encoders' instance-norm statistics
+        exactly as bucket fill does (see ``RAFTEngine.infer_batch``'s
+        accuracy note).
+        """
+        cfg = self.config
+        dt = cfg.compute_dtype
+        B, H, W, _ = image1.shape
+        assert H % 8 == 0 and W % 8 == 0, "capacity boxes are ÷8-aligned"
+
+        image1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
+        image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
+
+        fmaps = self.fnet(jnp.concatenate([image1, image2], axis=0),
+                          train=False, use_running_average=True)
+        from raft_tpu.kernels.corr_ragged_pallas import mask_features
+
+        fmap1 = mask_features(fmaps[:B].astype(jnp.float32),
+                              valid_h8, valid_w8)
+        fmap2 = mask_features(fmaps[B:].astype(jnp.float32),
+                              valid_h8, valid_w8)
+
+        corr_state, lookup = self._corr_setup(fmap1, fmap2)
+
+        cnet = self.cnet(image1, train=False, use_running_average=True)
+        net = jnp.tanh(cnet[..., :cfg.hidden_dim]).astype(dt)
+        inp = nn.relu(cnet[..., cfg.hidden_dim:]).astype(dt)
+
+        return self._refine(corr_state, lookup, net, inp, B, H, W,
+                            iters, flow_init, True)
+
     def forward_cached(self, image2, fmap1, cnet1,
                        flow_init: jax.Array, iters: int = 12):
         """Cross-frame cached serving: encode ONLY the new frame.

@@ -186,6 +186,19 @@ class ServingMetrics:
         self.h2d_requests = 0
         self._assembly_ms = 0.0
         self._assembly_overlapped_ms = 0.0
+        # padding-waste gauge (device pixels padded vs requested) on
+        # EVERY dispatch path — bucketed, cached, ragged — so a ragged
+        # A/B and the bucketed baseline report comparable waste
+        self.real_px = 0
+        self.padded_px = 0
+        # ragged capacity-class surface: how full the boxes ran
+        # (px-based — honest about capacity padding, not just row
+        # counts) and how often a dispatch actually coalesced ACROSS
+        # request shapes (the thing per-shape bucketing can never do)
+        self.ragged_dispatches = 0
+        self.ragged_cross_shape = 0
+        self.ragged_real_px = 0
+        self.ragged_padded_px = 0
         #: cross-frame feature cache (serving/feature_cache): when the
         #: scheduler arms a pool it points this at the pool's
         #: ``snapshot`` — every metrics snapshot then carries a
@@ -200,7 +213,8 @@ class ServingMetrics:
     def _bucket(self, key: str) -> Dict:
         b = self._buckets.get(key)
         if b is None:
-            b = {"dispatches": 0, "filled": 0, "capacity": 0}
+            b = {"dispatches": 0, "filled": 0, "capacity": 0,
+                 "real_px": 0, "padded_px": 0}
             for stage in _STAGES:
                 b[stage] = LatencyHistogram()
             self._buckets[key] = b
@@ -283,13 +297,31 @@ class ServingMetrics:
             self.abandoned_inflight += n
 
     def record_dispatch(self, bucket: str, filled: int, capacity: int,
-                        depth: int) -> None:
+                        depth: int, real_px: int = 0,
+                        padded_px: int = 0, ragged: bool = False,
+                        cross_shape: bool = False) -> None:
+        """``real_px``/``padded_px``: requested pixels vs the
+        executable's padded pixels for this dispatch (the padding-waste
+        gauge; 0/0 from duck-typed callers keeps the historical
+        records). ``ragged``: a capacity-class dispatch;
+        ``cross_shape``: it coalesced more than one distinct request
+        shape."""
         with self._lock:
             self.dispatches += 1
             b = self._bucket(bucket)
             b["dispatches"] += 1
             b["filled"] += filled
             b["capacity"] += capacity
+            b["real_px"] += real_px
+            b["padded_px"] += padded_px
+            self.real_px += real_px
+            self.padded_px += padded_px
+            if ragged:
+                self.ragged_dispatches += 1
+                self.ragged_real_px += real_px
+                self.ragged_padded_px += padded_px
+                if cross_shape:
+                    self.ragged_cross_shape += 1
             self._depth(depth)
 
     def record_complete(self, bucket: str, queue_ms: float,
@@ -447,6 +479,26 @@ class ServingMetrics:
                         round(self.dispatches / capacity, 4) if capacity
                         else 0.0,
                 },
+                # padded device pixels vs requested pixels, every
+                # dispatch path — the waste the ragged A/B compares
+                "padding_waste": {
+                    "real_px": self.real_px,
+                    "padded_px": self.padded_px,
+                    "waste_ratio": round(
+                        1.0 - self.real_px / self.padded_px, 4)
+                    if self.padded_px else 0.0,
+                },
+                "ragged": {
+                    "dispatches": self.ragged_dispatches,
+                    "cross_shape_dispatches": self.ragged_cross_shape,
+                    "cross_shape_coalesce_rate": round(
+                        self.ragged_cross_shape
+                        / self.ragged_dispatches, 4)
+                    if self.ragged_dispatches else 0.0,
+                    "capacity_fill": round(
+                        self.ragged_real_px / self.ragged_padded_px, 4)
+                    if self.ragged_padded_px else 0.0,
+                },
                 "hot_path": {
                     "dispatch_gap": self._gap.snapshot(),
                     "h2d_bytes": self.h2d_bytes,
@@ -480,6 +532,11 @@ class ServingMetrics:
                         "capacity": b["capacity"],
                         "occupancy": round(b["filled"] / b["capacity"], 4)
                         if b["capacity"] else 0.0,
+                        "real_px": b["real_px"],
+                        "padded_px": b["padded_px"],
+                        "padding_waste": round(
+                            1.0 - b["real_px"] / b["padded_px"], 4)
+                        if b["padded_px"] else 0.0,
                         **{stage: b[stage].snapshot()
                            for stage in _STAGES},
                     }

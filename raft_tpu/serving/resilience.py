@@ -217,7 +217,7 @@ class _DispatchJob:
     watchdog's clock)."""
 
     __slots__ = ("fn", "done", "error", "outcome", "bucket", "batch",
-                 "abandoned", "key", "t_start", "cached")
+                 "abandoned", "key", "t_start", "cached", "ragged")
 
     def __init__(self, fn: Optional[Callable[["_DispatchJob"], None]]):
         self.fn = fn
@@ -232,6 +232,9 @@ class _DispatchJob:
         #: feature-cache dispatch: a wedge verdict must drop the
         #: CACHED executable for ``bucket``, not its plain sibling
         self.cached = False
+        #: ragged capacity-class dispatch: the verdict's drop target
+        #: is the RAGGED table's executable for ``bucket``
+        self.ragged = False
 
 
 class DispatchExecutor:

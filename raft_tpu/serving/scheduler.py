@@ -279,7 +279,8 @@ class MicroBatchScheduler:
                  metrics: Optional[ServingMetrics] = None,
                  metrics_path: Optional[str] = None,
                  feature_cache: bool = False,
-                 feature_cache_capacity: int = 256):
+                 feature_cache_capacity: int = 256,
+                 ragged: bool = False):
         """(Trailing knobs) ``feature_cache=True`` (needs a
         ``RAFTEngine(feature_cache=True)``) arms the cross-frame
         device feature-cache pool: ``submit_cached`` becomes
@@ -289,7 +290,20 @@ class MicroBatchScheduler:
         through the cached bucket signature — one encoder pass and
         ONE frame of H2D per pair. Default OFF: no pool exists,
         ``submit_cached`` raises, everything else is bitwise
-        unchanged."""
+        unchanged.
+
+        ``ragged=True`` (needs a ``RAFTEngine(ragged=True)``): the
+        coalescing key becomes the engine's CAPACITY CLASS instead of
+        the request's ``(h, w)`` — requests of ANY shape mapping to
+        the same class box fill one micro-batch and dispatch through
+        ONE ragged executable (``infer_ragged_async``), with per-row
+        crops on the way out. Today's same-shape-only coalescing can
+        only fill a batch from one shape's queue; the ragged key fills
+        it from the whole mixed-shape queue. Breakers, deadlines,
+        priorities, pipelining and the accounting identity are
+        unchanged — a class is just a coarser bucket key (labelled
+        ``BxHxW/ragged``). Default OFF: keys, labels and dispatch are
+        byte-identical to the bucketed path."""
         self.engine = engine
         self.max_queue = int(max_queue)
         self.max_batch = int(max_batch)
@@ -314,6 +328,15 @@ class MicroBatchScheduler:
             raise ValueError(
                 "feature_cache=True needs an engine compiled with "
                 "feature_cache=True (the cached bucket signature)")
+        if ragged and not getattr(engine, "ragged", False):
+            raise ValueError(
+                "ragged=True needs an engine compiled with ragged=True "
+                "(the capacity-class executables)")
+        if ragged and feature_cache:
+            raise ValueError(
+                "ragged=True with feature_cache=True is not supported "
+                "yet — the cached signature keeps per-shape buckets")
+        self._ragged = bool(ragged)
         self._fcache = (FeatureCachePool(feature_cache_capacity)
                         if feature_cache else None)
         if self._fcache is not None:
@@ -443,7 +466,14 @@ class MicroBatchScheduler:
                     raise ValueError(
                         f"flow_init shape {tuple(flow_init.shape)} != "
                         f"{want} (1/8 of the ÷8-padded frame)")
-        key = tuple(image1.shape[:2])
+        if self._ragged:
+            # CROSS-SHAPE coalescing: the key is the capacity-class
+            # box this shape maps to, so mixed-shape requests share a
+            # queue group (and a breaker) — compiles nothing here
+            h, w = image1.shape[:2]
+            key = self.engine.ragged_class_for(h, w) + ("ragged",)
+        else:
+            key = tuple(image1.shape[:2])
         self._intake_guard(key)
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
@@ -622,14 +652,23 @@ class MicroBatchScheduler:
     #: at the same shape) — the one definition ``_key_label`` and the
     #: cached dispatch's bucket label both use
     CACHE_LABEL_SUFFIX = "/cache"
+    #: ragged capacity-class groups/buckets: the key dims are the
+    #: CLASS box, not a request shape, and the executable lives in the
+    #: engine's ragged table — its own failure domain too
+    RAGGED_LABEL_SUFFIX = "/ragged"
 
     @classmethod
     def _key_label(cls, key) -> str:
         """Namespace-less label for a coalescing-group key: ``HxW``,
-        plus :attr:`CACHE_LABEL_SUFFIX` for feature-cache groups —
-        shared by ``_label`` and ``health()``."""
+        plus :attr:`CACHE_LABEL_SUFFIX` / :attr:`RAGGED_LABEL_SUFFIX`
+        for feature-cache / capacity-class groups — shared by
+        ``_label`` and ``health()``."""
         base = f"{key[0]}x{key[1]}"
-        return base + cls.CACHE_LABEL_SUFFIX if len(key) > 2 else base
+        if len(key) > 2:
+            return base + (cls.RAGGED_LABEL_SUFFIX
+                           if key[2] == "ragged"
+                           else cls.CACHE_LABEL_SUFFIX)
+        return base
 
     def _label(self, key) -> str:
         """Breaker/event label for a request shape: ``model/HxW``
@@ -740,7 +779,16 @@ class MicroBatchScheduler:
         cap = self._capacity.get(key)
         if cap is None:
             h, w = key[0], key[1]
-            if len(key) > 2:
+            if len(key) > 2 and key[2] == "ragged":
+                # capacity-class group: key dims ARE the class box.
+                # Pre-warm ONE class at max_batch so every later fill
+                # count (and shape mix) batch-fills into it — the H3
+                # one-executable discipline, now across shapes.
+                fit = self.engine.ragged_capacity(h, w)
+                if fit is None:
+                    fit = self.engine.ensure_ragged(self.max_batch,
+                                                    h, w)[0]
+            elif len(key) > 2:
                 # feature-cache group: its own signature table — the
                 # plain kwarg-less calls below stay byte-identical for
                 # duck-typed engines without the cached API
@@ -1006,7 +1054,9 @@ class MicroBatchScheduler:
             # engine recovery: the executable that hung is suspect —
             # drop it (and the cached capacity routed through it) so
             # the half-open probe recompiles from clean state
-            if job.cached:
+            if job.ragged:
+                self.engine.drop_bucket(job.bucket, ragged=True)
+            elif job.cached:
                 self.engine.drop_bucket(job.bucket, cached=True)
             else:
                 self.engine.drop_bucket(job.bucket)
@@ -1056,7 +1106,11 @@ class MicroBatchScheduler:
         #                        results or record a breaker success
         label = self._label(key)
         if job.bucket is not None:
-            if job.cached:
+            if job.ragged:
+                # a ragged completion hung: indict the capacity-class
+                # executable in the ragged table
+                self.engine.drop_bucket(job.bucket, ragged=True)
+            elif job.cached:
                 # the executable that hung is the CACHED program —
                 # indict it, not its plain sibling at the same shape
                 self.engine.drop_bucket(job.bucket, cached=True)
@@ -1153,7 +1207,9 @@ class MicroBatchScheduler:
                 batch, self._wedge_error(key)))
             return
         if batch:
-            if len(key) > 2:
+            if len(key) > 2 and key[2] == "ragged":
+                self._dispatch_ragged(key, batch, job)
+            elif len(key) > 2:
                 self._dispatch_cached(key, batch, job)
             else:
                 self._dispatch(key, batch, job)
@@ -1298,8 +1354,14 @@ class MicroBatchScheduler:
             label = "x".join(map(str, bucket))
             with self._cv:
                 depth = len(self._q)
-            self.metrics.record_dispatch(label, filled=n,
-                                         capacity=bucket[0], depth=depth)
+            self.metrics.record_dispatch(
+                label, filled=n, capacity=bucket[0], depth=depth,
+                # padding-waste gauge: requested pixels vs the padded
+                # pixels the executable actually runs (batch fill +
+                # align pad + bucket fill) — comparable across the
+                # bucketed and ragged paths
+                real_px=n * h * w,
+                padded_px=bucket[0] * bucket[1] * bucket[2])
             fault_point("serve.request")
             if job.abandoned:
                 # wedge verdict landed while we were stuck above:
@@ -1389,6 +1451,117 @@ class MicroBatchScheduler:
                 self._completion.enqueue(cjob)
             job.outcome = "dispatched"   # the completion stage owns
             #                              the breaker verdict now
+        except Exception as exc:  # route to the callers; worker survives
+            self.metrics.record_failure(self._fail_requests(live, exc))
+            job.outcome = "failed"
+
+    # -- ragged (capacity-class) dispatch ----------------------------------
+
+    def _dispatch_ragged(self, key, batch: List[_Request],
+                         job: _DispatchJob) -> None:
+        """One MIXED-SHAPE micro-batch through a capacity-class
+        executable: every request in ``batch`` mapped to the same
+        class box (the submit-time key), whatever its own ``(h, w)``.
+        Assembly, warm starts and crops are per-row inside
+        ``engine.infer_ragged_async``; everything else — deadlines,
+        watchdog, breaker outcomes, pipelined completion, the
+        accounting identity — is the plain dispatch protocol with a
+        coarser bucket key."""
+        live: List[_Request] = []
+        for r in batch:
+            try:
+                running = r.future.set_running_or_notify_cancel()
+            except InvalidStateError:
+                continue  # wedge verdict settled it between take and here
+            if running:
+                live.append(r)
+            else:
+                self.metrics.record_cancelled()
+        if not live:
+            return
+        job.batch = live
+        job.ragged = True
+        ch, cw = key[0], key[1]
+        n = len(live)
+        t_disp = time.monotonic()
+        try:  # EVERYTHING here routes failures to the batch's futures
+            bucket = self.engine.route_ragged(n, ch, cw)
+            job.bucket = bucket
+            label = ("x".join(map(str, bucket))
+                     + self.RAGGED_LABEL_SUFFIX)
+            with self._cv:
+                depth = len(self._q)
+            shapes = {tuple(r.image1.shape[:2]) for r in live}
+            self.metrics.record_dispatch(
+                label, filled=n, capacity=bucket[0], depth=depth,
+                real_px=sum(r.image1.shape[0] * r.image1.shape[1]
+                            for r in live),
+                padded_px=bucket[0] * bucket[1] * bucket[2],
+                ragged=True, cross_shape=len(shapes) > 1)
+            fault_point("serve.request")
+            if job.abandoned:
+                self.metrics.record_failure(self._fail_requests(
+                    live, self._wedge_error(key)))
+                return
+            warm = getattr(self.engine, "warm_start", False)
+            prev = self._prev_pending
+            overlapped = prev is not None and prev.t_ready is None
+            t_asm0 = time.monotonic()
+            # box=(ch, cw): the engine routes on the SAME extents
+            # route_bucket above used, so the executable dispatched is
+            # exactly the one job.bucket/label name — a wedge verdict
+            # must drop the program that actually hung, never a
+            # same-key sibling class the batch's own maxima would
+            # route to
+            pairs = [(r.image1, r.image2) for r in live]
+            if warm:
+                low_dev = any(r.want_low and r.low_device for r in live)
+                pending = self.engine.infer_ragged_async(
+                    pairs,
+                    flow_inits=[r.flow_init for r in live],
+                    return_low=True, low_device=low_dev,
+                    box=(ch, cw))
+            else:
+                pending = self.engine.infer_ragged_async(
+                    pairs, box=(ch, cw))
+            t_call_end = time.monotonic()
+            gap_ms = None
+            if prev is not None:
+                gap_ms = (0.0 if prev.t_ready is None
+                          else max(0.0, (t_call_end - prev.t_ready)
+                                   * 1e3))
+            self.metrics.record_hot_path(
+                gap_ms=gap_ms, assembly_ms=(t_call_end - t_asm0) * 1e3,
+                overlapped=overlapped, h2d_bytes=pending.h2d_bytes,
+                requests=n)
+            self._prev_pending = pending
+            if job.abandoned:
+                n_failed = self._fail_requests(live,
+                                               self._wedge_error(key))
+                if n_failed:
+                    self.metrics.record_failure(n_failed)
+                return
+            if self._completion is None:
+                # per-row fetch output matches _settle's (flows, lows)
+                # protocol — the settle/accounting path is shared, not
+                # forked
+                self._settle(live, pending.fetch(), label, t_disp, warm)
+                job.outcome = "ok"
+                return
+            cjob = _DispatchJob(
+                lambda j, key=key, label=label, live=live,
+                pending=pending, t_disp=t_disp, warm=warm:
+                self._complete_batch(key, label, live, pending,
+                                     t_disp, warm, j))
+            cjob.key = key
+            cjob.bucket = bucket
+            cjob.ragged = True
+            cjob.batch = live
+            cjob.t_start = time.monotonic()
+            with self._pipe_lock:
+                self._pending_jobs.append(cjob)
+                self._completion.enqueue(cjob)
+            job.outcome = "dispatched"
         except Exception as exc:  # route to the callers; worker survives
             self.metrics.record_failure(self._fail_requests(live, exc))
             job.outcome = "failed"
@@ -1525,8 +1698,10 @@ class MicroBatchScheduler:
             # misses must not inflate the warm-video A/B numbers
             with self._cv:
                 depth = len(self._q)
-            self.metrics.record_dispatch(label, filled=len(live),
-                                         capacity=bucket[0], depth=depth)
+            self.metrics.record_dispatch(
+                label, filled=len(live), capacity=bucket[0],
+                depth=depth, real_px=len(live) * h * w,
+                padded_px=bucket[0] * bucket[1] * bucket[2])
             prev = self._prev_pending
             overlapped = prev is not None and prev.t_ready is None
             t_asm0 = time.monotonic()

@@ -126,6 +126,12 @@ class TestRaggedTrafficDrill:
             for stage in ("queue", "device", "total"):
                 assert b[stage]["count"] == b["filled"]
                 assert b[stage]["p50_ms"] <= b[stage]["p99_ms"]
+            # the BUCKETED path reports the padding-waste gauge too —
+            # comparable against a ragged A/B line by construction
+            assert 0 < b["real_px"] <= b["padded_px"]
+        assert (0 < rec["padding_waste"]["real_px"]
+                <= rec["padding_waste"]["padded_px"])
+        assert rec["ragged"]["dispatches"] == 0  # bucketed drill
 
     def test_sessions_coalesce_with_oneshot_traffic(self, engine,
                                                     small_setup):
@@ -1210,7 +1216,8 @@ class TestServingMetricsUnit:
         m = ServingMetrics(path)
         m.record_submit(depth=1)
         m.record_submit(depth=2)
-        m.record_dispatch("3x32x32", filled=2, capacity=3, depth=0)
+        m.record_dispatch("3x32x32", filled=2, capacity=3, depth=0,
+                          real_px=2 * 30 * 30, padded_px=3 * 32 * 32)
         m.record_complete("3x32x32", queue_ms=1.0, device_ms=2.0)
         m.record_complete("3x32x32", queue_ms=4.0, device_ms=2.0)
         m.record_shed()
@@ -1223,6 +1230,17 @@ class TestServingMetricsUnit:
         b = rec["buckets"]["3x32x32"]
         assert b["occupancy"] == round(2 / 3, 4)
         assert b["total"]["count"] == 2
+        # padding-waste gauge schema (both paths record through this
+        # one dispatch hook; the ragged block stays zeroed here)
+        assert b["real_px"] == 1800 and b["padded_px"] == 3072
+        assert b["padding_waste"] == round(1 - 1800 / 3072, 4)
+        pw = rec["padding_waste"]
+        assert pw["real_px"] == 1800 and pw["padded_px"] == 3072
+        assert pw["waste_ratio"] == round(1 - 1800 / 3072, 4)
+        assert rec["ragged"] == {"dispatches": 0,
+                                 "cross_shape_dispatches": 0,
+                                 "cross_shape_coalesce_rate": 0.0,
+                                 "capacity_fill": 0.0}
         assert rec["occupancy"]["mean"] > \
             rec["occupancy"]["one_per_dispatch_baseline"]
         assert again["step"] == 2

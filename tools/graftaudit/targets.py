@@ -164,6 +164,42 @@ def _build_serve_cached():
     return build
 
 
+def _build_serve_ragged():
+    def build():
+        jax = ensure_cpu()
+        import jax.numpy as jnp
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.models import RAFT
+
+        cfg = RAFTConfig()
+        model = RAFT(cfg)
+        h, w = _IMAGE_HW
+        # the ragged serving recipe (RAFTEngine(ragged=True,
+        # warm_start=True, wire="u8")): uint8 frames at the capacity
+        # box, the per-row validity descriptor as TRACED (B,) i32
+        # arguments (any shape mix is data, never a new program), and
+        # the warm-start flow_init donated to its same-shaped flow_low
+        # output exactly like the plain u8 warm engine — H4 verifies
+        # XLA honors the alias through the masked graph
+        img = jax.ShapeDtypeStruct((1, h, w, 3), jnp.uint8)
+        vspec = jax.ShapeDtypeStruct((1,), jnp.int32)
+        finit = jax.ShapeDtypeStruct((1, h // 8, w // 8, 2),
+                                     jnp.float32)
+        variables = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, h, w, 3)),
+                               jnp.zeros((1, h, w, 3)), iters=1))
+
+        def serve_ragged(variables, image1, image2, valid_h8, valid_w8,
+                         flow_init):
+            return model.apply(variables, image1, image2, valid_h8,
+                               valid_w8, flow_init, iters=_ITERS,
+                               method="forward_ragged")
+
+        return serve_ragged, (variables, img, img, vspec, vspec, finit)
+    return build
+
+
 # -- engine canaries ------------------------------------------------------
 
 _ENGINE_WEIGHTS = []   # [(variables, cfg)] — one real init, both canaries
@@ -346,6 +382,77 @@ def _build_engine_feature_cache():
                    "streams: cold->warm->evicted->re-primed->warm all "
                    "through ONE cached executable (no per-state "
                    "compile forks, no plain-signature strays)",
+            hlo_texts=texts)
+    return build
+
+
+def _build_engine_ragged():
+    def build():
+        ensure_cpu()
+        import numpy as np
+        from raft_tpu.serving.engine import RAFTEngine
+        from raft_tpu.serving.scheduler import MicroBatchScheduler
+
+        variables, cfg = _engine_weights()
+        h, w = _IMAGE_HW
+        # the ROADMAP's stated gate: a >=3-distinct-shape canary sweep
+        # through ONE ragged executable (the bucketed path compiles
+        # one per shape — pinned by the exact-shapes oracle below)
+        # class batch 1: the bitwise pin below runs the feature net at
+        # total batch 2 on BOTH sides (XLA CPU conv bits move with
+        # total batch past the vectorization width — the established
+        # bucket-batch-1 parity geometry); cross-shape batch
+        # coalescing is pinned at batch 2+ in tests/test_ragged.py
+        shapes = [(h, w), (h - 8, w), (h, w - 8)]
+        eng = RAFTEngine(variables, cfg, iters=_ITERS, ragged=True,
+                         capacity_classes=[(1, h, w)], precompile=True,
+                         warm_start=True)
+        rng = np.random.RandomState(0)
+
+        def pair(hh, ww):
+            return (rng.randint(0, 256, (hh, ww, 3)).astype(np.float32),
+                    rng.randint(0, 256, (hh, ww, 3)).astype(np.float32))
+
+        with MicroBatchScheduler(eng, max_batch=1,
+                                 gather_window_s=0.0,
+                                 ragged=True) as sched:
+            sweep = shapes + shapes[:1]
+            futs = [sched.submit(*pair(hh, ww)) for hh, ww in sweep]
+            flows = [f.result(timeout=600).flow for f in futs]
+            rec = sched.metrics.snapshot(
+                executables=eng.executable_count())
+        assert eng.executable_count() == 1, \
+            f"mixed-shape sweep forked ragged executables: " \
+            f"{eng.ragged_classes()}"
+        assert rec["ragged"]["dispatches"] > 0, \
+            "no dispatch rode the ragged path"
+        # the bucketed oracle: the SAME sweep on the per-shape path
+        # compiles one executable per shape (what the ragged table
+        # collapses to 1), and at bucket-batch-1 integer inputs every
+        # swept shape's flow is oracle-pinned against it — the
+        # full-extent shape BITWISE against the shared class here
+        # (identity mask); every shape bitwise at its own per-shape
+        # class in tests/test_ragged.py (same-geometry oracle).
+        oracle = RAFTEngine(variables, cfg, iters=_ITERS,
+                            exact_shapes=True, warm_start=True)
+        rng = np.random.RandomState(0)   # replay the sweep's pairs
+        for (hh, ww), flow in zip(sweep, flows):
+            i1, i2 = pair(hh, ww)
+            ref = oracle.infer_batch(i1[None], i2[None])[0]
+            if (hh, ww) == (h, w):
+                assert np.array_equal(flow, ref), \
+                    "full-extent ragged row is not bitwise the " \
+                    "bucketed path"
+        assert len(oracle._compiled) == len(shapes), \
+            "oracle did not compile one bucket per shape"
+        texts = tuple(exe.as_text()
+                      for exe in eng._compiled_ragged.values() if exe)
+        return CanaryResult(
+            observed_compiles=eng.executable_count(),
+            detail=f"ragged engine at capacity (1,{h},{w}): "
+                   f"{len(shapes)}-distinct-shape sweep through ONE "
+                   "executable (bucketed oracle: one per shape), "
+                   "full-extent row bitwise vs the oracle",
             hlo_texts=texts)
     return build
 
@@ -555,6 +662,20 @@ def build_targets() -> List[Target]:
                   "(RAFTEngine(wire='u8', warm_start=True)): uint8 "
                   "frames, on-device normalize, donated flow_init"),
         Target(
+            name="serve_ragged",
+            build=_build_serve_ragged(),
+            donate_argnums=(5,),   # flow_init -> flow_low alias: the
+            #                        u8-wire warm RAGGED engine donates
+            #                        it (arg 5 — after the two
+            #                        descriptor arrays) and H4 verifies
+            #                        XLA honors the alias through the
+            #                        masked graph
+            notes="ragged capacity-class serving recipe "
+                  "(RAFTEngine(ragged=True, warm_start=True, "
+                  "wire='u8')): uint8 frames, traced per-row validity "
+                  "descriptor, masked-tail correlation, donated "
+                  "flow_init"),
+        Target(
             name="serve_cached",
             build=_build_serve_cached(),
             donate_argnums=(2, 3, 4),   # fmap1 -> fmap2, cnet1 ->
@@ -583,6 +704,18 @@ def build_targets() -> List[Target]:
             build=_build_engine_exact_ragged(),
             expect_compiles=1,     # pinned in tests/test_serving.py
             notes="ragged-tail batch fill, exact_shapes mode"),
+        Target(
+            name="engine_ragged",
+            kind="canary",
+            build=_build_engine_ragged(),
+            expect_compiles=1,     # ONE executable for the whole
+            #                        mixed-shape sweep — the capacity
+            #                        class IS the compile unit; the
+            #                        bucketed oracle in the same build
+            #                        compiles one per shape
+            notes="ragged single-executable serving: 3-distinct-shape "
+                  "sweep through one capacity-class executable, "
+                  "full-extent row bitwise vs the bucketed oracle"),
         Target(
             name="engine_bucketed",
             kind="canary",

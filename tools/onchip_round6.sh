@@ -193,6 +193,29 @@ step serve_wire_r6 1800 python -m raft_tpu.cli.serve_bench \
     --wire u8 --pipeline-depth 2 --device-state \
     --log-dir /tmp/raft_serve_wire_r6
 
+# ---- ragged single-executable serving: mixed-shape A/B (PR 13) -------
+# serve_bench_r6's EXACT traffic again, served through ONE ragged
+# capacity-class executable (440x1024 box covers both shapes) instead
+# of one bucket per shape. Compare the two JSON lines: executables
+# (1 vs 2), capacity_fill / cross_shape_coalesce_rate (the bucketed
+# line can never coalesce across shapes), padding_waste_ratio (the
+# honest cost: the 368x496 requests run in the 440x1024 box), and
+# pairs_per_s — the fill-from-the-whole-queue win vs the capacity
+# padding cost is THE number this rung exists to measure. Warm-up leg
+# first: the ragged program is new HLO (one compile, which is the
+# point — a cold mixed-shape fleet pays ONE compile, not O(shapes)).
+step serve_ragged_r6_warm 1800 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 8 --submitters 2 \
+    --bucket-batch 4 --sessions 2 --session-frames 2 \
+    --deadline-ms 60000 --gather-ms 20 \
+    --ragged --capacity-classes 440x1024
+step serve_ragged_r6 1800 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 48 --submitters 2 \
+    --bucket-batch 4 --sessions 2 --session-frames 4 \
+    --deadline-ms 30000 --gather-ms 20 \
+    --ragged --capacity-classes 440x1024 \
+    --log-dir /tmp/raft_serve_ragged_r6
+
 # ---- cross-frame feature cache: warm-video A/B (PR 12) ---------------
 # same hot-path recipe + video-heavy traffic (long streams), A/B'd
 # against serve_wire_r6's configuration on the SAME session traffic:
