@@ -74,6 +74,15 @@ Under ``--chaos`` the plans additionally draw ``guardian.decide`` — a
 guardian that raises or hangs mid-decision must strand nothing and
 never leave a half-rolled canary, and the clean round must end in a
 guardian auto-promote.
+
+``--trace-path`` (+ ``--trace-sample R``) arms request-scoped tracing
+(serving/trace.py): every accepted request's span appends to the
+given spans.jsonl (tail exemplars and failures always kept), the
+summary line grows a ``tail_exemplars`` block (top-bucket span refs +
+the serve_trace phase attribution over them), and under ``--chaos``
+the drill additionally pins ZERO orphan spans — every accepted
+request closed exactly one span. Read the file back with
+``python -m raft_tpu.cli.serve_trace``.
 """
 
 from __future__ import annotations
@@ -131,6 +140,29 @@ def chaos_plan(rng: random.Random, hang_s: float = 0.5,
     return {"seed": rng.randrange(1 << 16), "faults": faults}
 
 
+def _trace_file_view(trace_path):
+    """The serve_trace read-back over one spans file — the
+    whole-file phase attribution + top-bucket membership every drill
+    summary assembles the same way."""
+    from raft_tpu.cli.serve_trace import (load_spans,
+                                          phase_attribution,
+                                          top_bucket_membership)
+    spans = (load_spans(trace_path)
+             if trace_path and os.path.exists(trace_path) else [])
+    return {"phase_attribution": phase_attribution(spans),
+            "top_bucket": top_bucket_membership(spans)}
+
+
+def _fresh_trace_file(trace_path):
+    """Start a drill's spans file FRESH. The summary reads the whole
+    file back, and a new ledger restarts its trace ids at r-1 — a
+    reused --trace-path would mix a previous run's spans into this
+    run's attribution AND duplicate ids (metrics.jsonl appends by
+    convention; spans.jsonl is per-run evidence)."""
+    if trace_path and os.path.exists(trace_path):
+        os.remove(trace_path)
+
+
 def _capacity_envelope(shapes, capacity_classes, bucket_batch):
     """The ragged engine's class list: the explicit ``--capacity-classes``
     boxes, or one box covering every drill shape (the O(1)-compile
@@ -151,7 +183,8 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
               feature_cache=False, cache_capacity=256,
               ragged=False, capacity_classes=None,
               fault_plan=None, recover_s=0.0,
-              metrics_path=None, seed=0, engine=None):
+              metrics_path=None, trace_path=None, trace_sample=1.0,
+              tracer=None, seed=0, engine=None):
     """The drill as a library call (tests reuse it, and may pass a
     prebuilt warm-start ``engine`` to share compiles across drills).
     Returns the summary dict the CLI prints.
@@ -175,7 +208,16 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     coalesces ACROSS shapes into it — the A/B against the same traffic
     without the flag compares ``executables`` (O(1) vs O(shapes)),
     ``capacity_fill``, ``cross_shape_coalesce_rate`` and
-    ``padding_waste_ratio``."""
+    ``padding_waste_ratio``.
+
+    ``trace_path`` arms request-scoped tracing (serving/trace.py):
+    spans append there under ``trace_sample`` with always-keep-tail
+    exemplars, and the summary grows a ``tail_exemplars`` block (the
+    top-bucket span refs + the serve_trace phase attribution over
+    them + the ledger counters). ``tracer`` injects a prebuilt ledger
+    (the chaos harness shares ONE across rounds so trace ids stay
+    unique in the shared file). Default off: summary byte-identical
+    to the untraced drill."""
     import numpy as np
 
     from raft_tpu.serving.engine import RAFTEngine
@@ -211,6 +253,11 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     _n_exec = getattr(engine, "executable_count",
                       lambda: len(engine._compiled))
     documented = _n_exec()
+    own_ledger = tracer is None and bool(trace_path)
+    if own_ledger:
+        from raft_tpu.serving.trace import TraceLedger
+        _fresh_trace_file(trace_path)
+        tracer = TraceLedger(trace_path, sample_rate=trace_sample)
     sched = MicroBatchScheduler(engine, max_queue=max_queue,
                                 max_batch=bucket_batch,
                                 gather_window_s=gather_window_s,
@@ -223,7 +270,8 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                                 feature_cache=feature_cache,
                                 feature_cache_capacity=cache_capacity,
                                 ragged=ragged,
-                                metrics_path=metrics_path)
+                                metrics_path=metrics_path,
+                                tracer=tracer)
     if feature_cache and sessions:
         # compile-outside-the-measurement discipline (the engine's
         # envelope precompile, one layer up): the device forward-warp
@@ -360,7 +408,7 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                        if b["state"] != "closed")
     hot = rec["hot_path"]
     fc = rec.get("feature_cache") or {}
-    return {
+    summary = {
         "wire": getattr(engine, "wire", "f32"),
         "pipeline_depth": pipeline_depth,
         "submitted": rec["submitted"],
@@ -416,6 +464,30 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
         "wall_s": round(wall, 3),
         "pairs_per_s": round(total_served / wall, 2) if wall else 0.0,
     }
+    if tracer is not None:
+        # request-tracing surface (key absent when tracing is off —
+        # the summary stays byte-identical to the untraced drill):
+        # top-bucket span refs from this run's metrics snapshot + its
+        # raw accounting counters (the numbers the span classes must
+        # reconcile against bucket-for-bucket; recovery probes
+        # included — summary["served"] is not)
+        summary["tail_exemplars"] = {
+            "refs": (rec.get("tail_exemplars") or {}).get("refs", []),
+            "accounting": {k: rec[k] for k in
+                           ("submitted", "completed", "failed",
+                            "deadline_missed", "cancelled")},
+        }
+        if own_ledger:
+            # the ledger and spans file belong to this run alone:
+            # counters and the serve_trace read-back are THIS run's.
+            # Under a SHARED ledger (chaos rounds) both are
+            # cumulative across rounds — the caller owns that view;
+            # mixing it into a per-round block would sit cumulative
+            # numbers next to per-round counters.
+            summary["tail_exemplars"]["ledger"] = tracer.snapshot()
+            summary["tail_exemplars"].update(
+                _trace_file_view(tracer.path))
+    return summary
 
 
 def _round_violations(s: dict) -> list:
@@ -448,7 +520,7 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                     feature_cache=False, cache_capacity=256,
                     ragged=False, capacity_classes=None,
                     deadline_s=None, seed=0, metrics_path=None,
-                    engine=None):
+                    trace_path=None, trace_sample=1.0, engine=None):
     """``rounds`` randomized fault rounds + one clean recovery round
     over ONE shared engine (dropped buckets recompile lazily across
     rounds), asserting the invariants after each. Returns the summary
@@ -459,7 +531,14 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
     larger healthy bucket), pinning the documented executable count
     after the final clean round. With ``ragged=True`` the wedge/drop/
     recompile cycle runs against the capacity-class table instead —
-    the chaos passthrough the ragged path must survive unchanged."""
+    the chaos passthrough the ragged path must survive unchanged.
+
+    ``trace_path`` arms request tracing across EVERY round through
+    ONE shared ledger (trace ids stay unique in the shared file), and
+    the chaos invariants grow the span/accounting identity: zero open
+    spans after the drill (every accepted request closed exactly one
+    span) — the wedge/eviction/deadline outcome tags the test layer
+    reconciles bucket-for-bucket."""
     from raft_tpu.serving.engine import RAFTEngine
 
     rng = random.Random(seed)
@@ -502,6 +581,11 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
     _n_exec = getattr(engine, "executable_count",
                       lambda: len(engine._compiled))
     documented = _n_exec()
+    tracer = None
+    if trace_path:
+        from raft_tpu.serving.trace import TraceLedger
+        _fresh_trace_file(trace_path)
+        tracer = TraceLedger(trace_path, sample_rate=trace_sample)
     per_round = []
     violations = []
     common = dict(shapes=shapes, requests=requests,
@@ -519,7 +603,7 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                   cache_capacity=cache_capacity,
                   ragged=ragged, capacity_classes=capacity_classes,
                   recover_s=recover_s, metrics_path=metrics_path,
-                  engine=engine)
+                  tracer=tracer, engine=engine)
     sites = (CHAOS_SITES_PIPELINED if pipeline_depth > 1
              else CHAOS_SITES)
     for r in range(rounds):
@@ -555,6 +639,10 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
         violations.append(
             f"executables {_n_exec()} != documented "
             f"{documented} after recovery (leaked/lost bucket)")
+    if tracer is not None and tracer.open_count():
+        violations.append(
+            f"orphan spans: {tracer.open_count()} accepted requests "
+            f"never closed a span ({tracer.open_ids()[:8]})")
     if feature_cache:
         # the pool must never leak past its bound — capacity is the
         # memory contract thousands of sessions lean on
@@ -570,7 +658,7 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                "errors", "wedged_dispatches", "quarantined_threads")}
     transitions = {k: sum(p["breaker_transitions"][k] for p in per_round)
                    for k in ("open", "half_open", "closed")}
-    return {
+    out = {
         "chaos_rounds": rounds,
         "violations": violations,
         "documented_buckets": documented,
@@ -579,6 +667,13 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
         "totals": totals,
         "per_round": per_round,
     }
+    if tracer is not None:
+        # whole-drill trace view (the per-round blocks carry only
+        # their OWN refs/accounting — the shared ledger counters and
+        # spans file cover all rounds, so both live here, once)
+        out["trace"] = tracer.snapshot()
+        out["tail_exemplars"] = _trace_file_view(trace_path)
+    return out
 
 
 def _merged_priority_blocks(variant_snaps):
@@ -627,7 +722,8 @@ def run_registry_drill(models, *, shapes, requests=48, submitters=2,
                        admission_budget=None, admission_reserve=None,
                        guardian=False, guardian_policy=None,
                        guardian_poll_s=0.05, guardian_timeout_s=30.0,
-                       fault_plan=None, metrics_path=None, seed=0,
+                       fault_plan=None, metrics_path=None,
+                       trace_path=None, trace_sample=1.0, seed=0,
                        engines=None, canary_engine=None):
     """Mixed-model, mixed-priority drill over a ``ModelRegistry``.
 
@@ -668,7 +764,11 @@ def run_registry_drill(models, *, shapes, requests=48, submitters=2,
 
     envelope = sorted({(bucket_batch, _ceil8(h), _ceil8(w))
                        for h, w in shapes})
-    reg = ModelRegistry(metrics_path=metrics_path, max_queue=max_queue,
+    _fresh_trace_file(trace_path)
+    reg = ModelRegistry(metrics_path=metrics_path,
+                        trace_path=trace_path,
+                        trace_sample=trace_sample,
+                        max_queue=max_queue,
                         max_batch=bucket_batch,
                         gather_window_s=gather_window_s,
                         dispatch_timeout_s=dispatch_timeout_s,
@@ -869,7 +969,7 @@ def run_registry_drill(models, *, shapes, requests=48, submitters=2,
     all_snaps = [s for name, _, _ in models
                  for s in _variant_snaps(snap[name])]
     total_served = served + session_stats["pairs"]
-    return {
+    summary = {
         "registry": True,
         "model_names": [name for name, _, _ in models],
         "submitted": sum(b["submitted"] for b in per_model.values()),
@@ -897,6 +997,14 @@ def run_registry_drill(models, *, shapes, requests=48, submitters=2,
         "wall_s": round(wall, 3),
         "pairs_per_s": round(total_served / wall, 2) if wall else 0.0,
     }
+    if reg.tracer is not None:
+        # spans carry the model/variant/canary stamps the registry
+        # minted — the phase attribution here covers ALL models
+        summary["tail_exemplars"] = {
+            **_trace_file_view(trace_path),
+            "ledger": reg.tracer.snapshot(),
+        }
+    return summary
 
 
 def _registry_round_violations(s: dict) -> list:
@@ -1273,6 +1381,20 @@ def main(argv=None):
     p.add_argument("--log-dir", default=None,
                    help="append the metrics snapshot to "
                         "<log-dir>/metrics.jsonl")
+    p.add_argument("--trace-path", default=None, metavar="PATH",
+                   help="arm request-scoped tracing: write span "
+                        "records (serving/trace.py) here — the file "
+                        "is started FRESH each run (per-run trace "
+                        "ids; move it aside to keep old evidence); "
+                        "the summary grows a tail_exemplars block and "
+                        "raft_tpu.cli.serve_trace reads the file back")
+    p.add_argument("--trace-sample", type=float, default=None,
+                   metavar="R",
+                   help="span sampling rate in [0,1] (default 1.0 "
+                        "when tracing is armed); tail exemplars and "
+                        "failures are always kept. Without "
+                        "--trace-path, spans land beside the metrics "
+                        "at <log-dir>/spans.jsonl")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
@@ -1295,6 +1417,25 @@ def main(argv=None):
                          "registry rungs keep the bucketed path)")
     metrics_path = (os.path.join(args.log_dir, "metrics.jsonl")
                     if args.log_dir else None)
+    trace_path = args.trace_path
+    if trace_path is None and args.trace_sample is not None:
+        if not args.log_dir:
+            raise SystemExit("--trace-sample needs --trace-path or "
+                             "--log-dir (for the default "
+                             "<log-dir>/spans.jsonl)")
+        trace_path = os.path.join(args.log_dir, "spans.jsonl")
+    trace_sample = (args.trace_sample if args.trace_sample is not None
+                    else 1.0)
+    if not 0.0 <= trace_sample <= 1.0:
+        raise SystemExit(f"--trace-sample {trace_sample}: must be "
+                         "in [0, 1]")
+    if trace_path and args.models and args.chaos:
+        raise SystemExit("--trace-path with --models --chaos is not "
+                         "wired yet (each chaos round builds a fresh "
+                         "registry/ledger and the shared spans file "
+                         "would repeat trace ids) — trace the "
+                         "single-model chaos or the plain registry "
+                         "drill")
     if (args.guardian or args.admission_budget) and not args.models:
         raise SystemExit("--guardian/--admission-budget need --models "
                          "(they are ModelRegistry features)")
@@ -1392,7 +1533,8 @@ def main(argv=None):
             guardian_timeout_s=max(30.0, 8 * args.bake_ms / 1e3),
             admission_budget=args.admission_budget or None,
             admission_reserve=args.admission_reserve,
-            metrics_path=metrics_path, seed=args.seed)
+            metrics_path=metrics_path, trace_path=trace_path,
+            trace_sample=trace_sample, seed=args.seed)
         print(json.dumps(summary), flush=True)
         return
 
@@ -1423,7 +1565,8 @@ def main(argv=None):
             cache_capacity=args.cache_capacity,
             ragged=args.ragged, capacity_classes=capacity_classes,
             max_queue=args.queue, seed=args.seed,
-            metrics_path=metrics_path)
+            metrics_path=metrics_path, trace_path=trace_path,
+            trace_sample=trace_sample)
         print(json.dumps(summary), flush=True)
         if summary["violations"]:
             raise SystemExit(1)
@@ -1447,7 +1590,8 @@ def main(argv=None):
         cache_capacity=args.cache_capacity,
         ragged=args.ragged, capacity_classes=capacity_classes,
         recover_s=args.recover_s,
-        metrics_path=metrics_path, seed=args.seed)
+        metrics_path=metrics_path, trace_path=trace_path,
+        trace_sample=trace_sample, seed=args.seed)
     print(json.dumps(summary), flush=True)
 
 

@@ -33,7 +33,18 @@ def _load_hlo_stats(trace_dir: str):
                              recursive=True))
     if not paths:
         raise SystemExit(f"no *.xplane.pb under {trace_dir}")
-    from xprof.profile_plugin import convert  # heavy import, keep lazy
+    try:
+        # heavy import, keep lazy — and optional: xprof ships with the
+        # TPU profiling stack, not with the base env this CLI parses
+        # tables in (tests run the report layer without it)
+        from xprof.profile_plugin import convert
+    except ImportError as exc:
+        raise SystemExit(
+            "trace_summary needs the XProf trace converter to read "
+            f"*.xplane.pb ({exc}).\nInstall it in the capture env: "
+            "pip install xprof  (ships with recent tensorboard-"
+            "plugin-profile builds),\nor run this tool where "
+            "profile_step captured the trace.")
 
     data = convert.xspace_to_tool_data(paths, "hlo_stats", {})
     out = data[0] if isinstance(data, tuple) else data

@@ -19,6 +19,13 @@ stranded futures — supervised by the SLO guardian (``guardian.py``):
 automated canary judgment over bake-window metrics with auto-promote/
 auto-rollback, plus the registry-wide admission budget that keeps one
 model's flood out of every other model's queue headroom.
+
+Request-scoped tracing (``trace.py``) threads one span per accepted
+request through all of it — phase timestamps, coalesce fan-in,
+cache/breaker/rollout annotations, tail-latency exemplars — written
+to ``spans.jsonl`` and read back by ``raft_tpu.cli.serve_trace``; the
+metrics.jsonl record/event schemas every layer emits are consolidated
+in ``schema.py``.
 """
 
 from raft_tpu.serving.engine import (SHAPE_ENVELOPE_LINUX, RAFTEngine,
@@ -40,6 +47,7 @@ from raft_tpu.serving.scheduler import (PRIORITY_BATCH,
                                         MicroBatchScheduler, SchedulerClosed,
                                         ServeResult)
 from raft_tpu.serving.session import VideoSession
+from raft_tpu.serving.trace import TraceLedger
 
 __all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX", "MicroBatchScheduler",
            "BackpressureError", "DeadlineExceeded", "SchedulerClosed",
@@ -50,4 +58,4 @@ __all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX", "MicroBatchScheduler",
            "canary_hash_fraction", "PRIORITY_INTERACTIVE",
            "PRIORITY_BATCH", "SLOGuardian", "GuardianPolicy",
            "AdmissionBudget", "settle_future", "FeatureCachePool",
-           "FeatureCacheMiss", "StaleFeatureError"]
+           "FeatureCacheMiss", "StaleFeatureError", "TraceLedger"]

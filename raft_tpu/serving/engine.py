@@ -73,9 +73,9 @@ class PendingBatch:
     dispatch-gap histogram reads it.
     """
 
-    __slots__ = ("bucket", "h2d_bytes", "t_ready", "_flow", "_flow_low",
-                 "_crop", "_return_low", "_low_device", "_inputs",
-                 "_donated", "_cache")
+    __slots__ = ("bucket", "h2d_bytes", "t_ready", "span_ctx", "_flow",
+                 "_flow_low", "_crop", "_return_low", "_low_device",
+                 "_inputs", "_donated", "_cache")
 
     def __init__(self, flow, flow_low, crop, bucket, h2d_bytes,
                  return_low, low_device, inputs=None, donated=False,
@@ -105,6 +105,12 @@ class PendingBatch:
         #: buffers alias the DONATED assembled cache inputs. fetch()
         #: then returns the four-tuple cached form.
         self._cache = cache
+        #: request-tracing span context (serving/trace.py): the
+        #: scheduler parks its batch's spans here at dispatch so the
+        #: pipelined completion stage can stamp the ``fetch_start``
+        #: phase edge from the pending it actually blocks on. None
+        #: (tracing off) costs nothing.
+        self.span_ctx = None
         self.t_ready: Optional[float] = None
 
     def fetch(self):
@@ -201,8 +207,8 @@ class RaggedPendingBatch:
     accounting (request pixels vs box pixels) for the padding-waste
     gauge."""
 
-    __slots__ = ("bucket", "h2d_bytes", "t_ready", "real_px",
-                 "padded_px", "_flow", "_flow_low", "_rows",
+    __slots__ = ("bucket", "h2d_bytes", "t_ready", "span_ctx",
+                 "real_px", "padded_px", "_flow", "_flow_low", "_rows",
                  "_return_low", "_low_device", "_inputs", "_donated")
 
     def __init__(self, flow, flow_low, rows, bucket, h2d_bytes,
@@ -221,6 +227,9 @@ class RaggedPendingBatch:
         self._donated = donated
         self.real_px = real_px
         self.padded_px = padded_px
+        #: request-tracing span context — same contract as
+        #: :attr:`PendingBatch.span_ctx`
+        self.span_ctx = None
         self.t_ready: Optional[float] = None
 
     def fetch(self):

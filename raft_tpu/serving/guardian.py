@@ -153,7 +153,12 @@ def window_stats(cur: Dict, base: Dict) -> Dict:
     """One variant's bake-window view: the delta of two cumulative
     variant snapshots (serving/metrics.py schema). Counters subtract;
     the latency histogram subtracts COUNTS bucket-by-bucket, so the
-    window p99 is the window's, not the variant lifetime's."""
+    window p99 is the window's, not the variant lifetime's. With
+    request tracing armed the variant snapshot carries
+    ``tail_exemplars`` — the window view keeps the exemplar refs NEW
+    since the baseline, so a guardian decision's evidence names the
+    exact trace ids behind the p99 it judged (walk them with
+    ``raft_tpu.cli.serve_trace``)."""
     completed = cur["completed"] - base["completed"]
     failed = cur["failed"] - base["failed"]
     requests = completed + failed
@@ -163,7 +168,7 @@ def window_stats(cur: Dict, base: Dict) -> Dict:
     h.count = sum(h.counts)
     h.max = cur["latency"]["max_ms"]   # lifetime max: pessimistic tail
     cur_r, base_r = cur["resilience"], base["resilience"]
-    return {
+    out = {
         "requests": requests,
         "completed": completed,
         "failed": failed,
@@ -173,6 +178,14 @@ def window_stats(cur: Dict, base: Dict) -> Dict:
         "breaker_opens": (cur_r["breaker_transitions"]["open"]
                           - base_r["breaker_transitions"]["open"]),
     }
+    refs = (cur.get("tail_exemplars") or {}).get("refs")
+    if refs:
+        seen = {e["trace_id"]
+                for e in (base.get("tail_exemplars")
+                          or {}).get("refs", [])}
+        out["exemplars"] = [dict(e) for e in refs
+                            if e["trace_id"] not in seen][-8:]
+    return out
 
 
 class _Bake:

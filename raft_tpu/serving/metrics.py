@@ -17,6 +17,7 @@ reads training and serving records side by side. Deliberately jax-free.
 from __future__ import annotations
 
 import bisect
+import collections
 import json
 import math
 import os
@@ -55,8 +56,15 @@ class LatencyHistogram:
         self.total = 0.0
         self.max = 0.0
 
+    def bucket_idx(self, ms: float) -> int:
+        """The ladder bucket :meth:`observe` bins ``ms`` into — the
+        ONE binning definition (the tail-exemplar refs and
+        serve_trace's top-bucket membership both reuse it, so they
+        can never drift from the histogram they must reproduce)."""
+        return bisect.bisect_left(self.bounds, ms)
+
     def observe(self, ms: float) -> None:
-        self.counts[bisect.bisect_left(self.bounds, ms)] += 1
+        self.counts[self.bucket_idx(ms)] += 1
         self.count += 1
         self.total += ms
         if ms > self.max:
@@ -207,6 +215,16 @@ class ServingMetrics:
         #: stays a leaf; see the T3 declarations). None = no block,
         #: the historical schema byte for byte.
         self.feature_cache_provider: Optional[Callable[[], Dict]] = None
+        #: tail exemplars (request tracing, serving/trace.py): when
+        #: ``record_complete`` carries a trace id, completions landing
+        #: in the latency histogram's top occupied bucket are kept as
+        #: exemplar REFS here (bounded), the snapshot grows a
+        #: ``tail_exemplars`` block, and the guardian's evidence
+        #: windows carry the refs. With tracing off no trace id ever
+        #: arrives — the deque stays empty and the snapshot schema is
+        #: byte-identical to the untraced stack.
+        self._exemplars = collections.deque(maxlen=64)
+        self._tail_max_idx = -1
 
     # -- recording --------------------------------------------------------
 
@@ -326,18 +344,39 @@ class ServingMetrics:
 
     def record_complete(self, bucket: str, queue_ms: float,
                         device_ms: float,
-                        priority: Optional[str] = None) -> None:
+                        priority: Optional[str] = None,
+                        trace_id: Optional[str] = None) -> bool:
+        """Record one completion. ``trace_id`` (request tracing
+        armed): the completion is judged against the latency
+        histogram's top occupied bucket — returns True when it IS a
+        tail exemplar (the request landed in the top bucket, so its
+        span must be retained whatever the sample rate says), and its
+        ref lands in the snapshot's ``tail_exemplars`` block. Without
+        a trace id (tracing off) the return is always False and
+        nothing new is recorded — the historical behavior."""
+        total = queue_ms + device_ms
         with self._lock:
             self.completed += 1
             b = self._bucket(bucket)
             b["queue"].observe(queue_ms)
             b["device"].observe(device_ms)
-            b["total"].observe(queue_ms + device_ms)
-            self._latency.observe(queue_ms + device_ms)
+            b["total"].observe(total)
+            self._latency.observe(total)
             p = self._prio(priority)
             if p is not None:
                 p["completed"] += 1
-                p["latency"].observe(queue_ms + device_ms)
+                p["latency"].observe(total)
+            if trace_id is None:
+                return False
+            idx = self._latency.bucket_idx(total)
+            tail = idx >= self._tail_max_idx
+            if idx > self._tail_max_idx:
+                self._tail_max_idx = idx
+            if tail:
+                self._exemplars.append(
+                    {"trace_id": trace_id, "bucket": bucket,
+                     "total_ms": round(total, 3), "bucket_idx": idx})
+            return tail
 
     def record_failure(self, n: int = 1) -> None:
         with self._lock:
@@ -545,6 +584,22 @@ class ServingMetrics:
             }
             if fcache is not None:
                 rec["feature_cache"] = fcache
+            if self._exemplars:
+                # request tracing armed: refs of completions in the
+                # CURRENT top occupied latency bucket — the span ids
+                # serve_trace's phase attribution runs over (early
+                # exemplars overtaken by a later, slower top bucket
+                # are filtered here; their spans stay retained).
+                # Absent whenever tracing is off: additive schema.
+                top = self._tail_max_idx
+                refs = [dict(e) for e in self._exemplars
+                        if e["bucket_idx"] == top]
+                rec["tail_exemplars"] = {
+                    "top_bucket_idx": top,
+                    "top_bucket_gt_ms": (self._latency.bounds[top - 1]
+                                         if top > 0 else 0.0),
+                    "refs": refs,
+                }
             if self.namespace is not None:
                 rec["model"] = self.namespace
         return rec

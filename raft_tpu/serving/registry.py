@@ -65,6 +65,7 @@ from raft_tpu.serving.metrics import ServingMetrics
 from raft_tpu.serving.scheduler import (BackpressureError,
                                         MicroBatchScheduler,
                                         SchedulerClosed)
+from raft_tpu.serving.trace import TraceLedger
 from raft_tpu.testing.faults import fault_point
 
 #: graftthread T3: the registry lock is the OUTERMOST serving lock —
@@ -184,7 +185,19 @@ class ModelRegistry:
     def __init__(self, *, metrics_path: Optional[str] = None,
                  admission_budget: Optional[int] = None,
                  admission_interactive_reserve: Optional[int] = None,
+                 trace_path: Optional[str] = None,
+                 trace_sample: float = 1.0,
                  **scheduler_defaults):
+        """``trace_path`` arms request-scoped tracing registry-wide:
+        ONE shared :class:`~raft_tpu.serving.trace.TraceLedger` writes
+        every variant's spans to one ``spans.jsonl`` (ids unique
+        across models), every variant scheduler gets it as its
+        ``tracer``, and ``submit``/``submit_cached`` stamp each span
+        with the routing decision (model, variant version, canary
+        assignment) the scheduler below can't see. ``trace_sample``
+        is the ledger's keep fraction (tail exemplars and failures
+        are always kept). Default None: no ledger, bitwise the
+        untraced registry."""
         self._lock = threading.RLock()
         self._models: Dict[str, _Model] = {}
         self._metrics_path = metrics_path
@@ -193,6 +206,12 @@ class ModelRegistry:
         self._budget = (AdmissionBudget(admission_budget,
                                         admission_interactive_reserve)
                         if admission_budget else None)
+        #: shared request-tracing ledger (None = tracing off); public
+        #: so sessions chain parents through the registry duck-typed,
+        #: like they do off a plain scheduler
+        self.tracer = (TraceLedger(trace_path,
+                                   sample_rate=trace_sample)
+                       if trace_path is not None else None)
         self._closed = False
 
     @property
@@ -249,6 +268,11 @@ class ModelRegistry:
         ns = f"{name}@{version}"
         metrics = ServingMetrics(self._metrics_path, namespace=ns)
         merged = {**self._sched_defaults, **sched_kw}
+        if self.tracer is not None:
+            # every variant shares the registry's ledger: one
+            # spans.jsonl, registry-unique trace ids, rollout-proof
+            # session chains
+            merged.setdefault("tracer", self.tracer)
         if getattr(engine, "feature_cache", False):
             # a feature-cache engine gets a feature-cache scheduler:
             # the per-variant pool is what the rollout brooms flush
@@ -605,20 +629,37 @@ class ModelRegistry:
             fut.add_done_callback(lambda _f: self._budget.release())
         return fut
 
+    def _trace_stamp(self, m: _Model, target: _Variant) -> None:
+        """Stamp the routing decision onto the span the next submit on
+        THIS thread mints (trace.py's thread-local intake context) —
+        the model/variant/canary assignment only the registry knows."""
+        if self.tracer is not None:
+            self.tracer.stamp_intake(
+                model=m.name, variant=target.version,
+                canary=target.state == MODEL_CANARY)
+
     def _submit_variant(self, m: _Model, target: _Variant, call):
         try:
-            return call(target.scheduler)
-        except SchedulerClosed:
-            # raced a promote/rollback into a draining variant (the
-            # canary, or the old live of a new-arch promote): the
-            # rollout machinery must be invisible — re-route to the
-            # CURRENT live. If the registry itself is closing, the
-            # live scheduler is closed too and the error propagates.
-            with self._lock:
-                live = m.live
-            if live is target:
-                raise
-            return call(live.scheduler)
+            self._trace_stamp(m, target)
+            try:
+                return call(target.scheduler)
+            except SchedulerClosed:
+                # raced a promote/rollback into a draining variant (the
+                # canary, or the old live of a new-arch promote): the
+                # rollout machinery must be invisible — re-route to the
+                # CURRENT live. If the registry itself is closing, the
+                # live scheduler is closed too and the error propagates.
+                with self._lock:
+                    live = m.live
+                if live is target:
+                    raise
+                self._trace_stamp(m, live)
+                return call(live.scheduler)
+        finally:
+            if self.tracer is not None:
+                # a rejected submit must not leak its stamp into an
+                # unrelated later span on this thread
+                self.tracer.clear_intake()
 
     def update_weights(self, variables, model: Optional[str] = None
                        ) -> None:

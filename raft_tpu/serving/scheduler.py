@@ -106,6 +106,16 @@ ServingMetrics`: per-bucket latency histograms for each stage
 deadline-miss counters, wedge/quarantine/breaker counters, snapshotted
 to ``metrics.jsonl`` on close and dumpable on demand
 (``write_metrics``).
+
+Request-scoped tracing (ISSUE 14; ``tracer=`` default None = bitwise
+the above): a :class:`~raft_tpu.serving.trace.TraceLedger` mints a
+span per ACCEPTED request at intake and closes it exactly once on the
+path that settled its future, with the outcome tag matching the
+accounting-identity class it was counted under; dispatches add fan-in
+spans (bucket key, padding-waste share) linked to their request
+spans, and phase marks (taken/shipped/fetch_start) give
+``serve_trace`` the queue-vs-assembly-vs-device-vs-fetch attribution
+behind a p99 spike.
 """
 
 from __future__ import annotations
@@ -128,6 +138,7 @@ from raft_tpu.serving.resilience import (BREAKER_CLOSED, BREAKER_OPEN,
                                          CircuitBreaker, CircuitOpen,
                                          DispatchExecutor, DispatchWedged,
                                          _DispatchJob)
+from raft_tpu.serving.trace import TraceLedger
 from raft_tpu.testing.faults import fault_point
 
 
@@ -157,6 +168,11 @@ LOCK_ORDER = (
      "resilience.DispatchExecutor._lock"),
     ("scheduler.MicroBatchScheduler._state_lock",
      "resilience.CircuitBreaker._lock"),
+    # span closes run from the deadline sweep (under _cv) into the
+    # trace ledger's leaf lock — never the reverse (the ledger calls
+    # back into nothing, and its file I/O happens lock-free in flush)
+    ("scheduler.MicroBatchScheduler._cv",
+     "trace.TraceLedger._lock"),
 )
 
 #: graftthread T6: wedge verdicts must land every consequence (drop
@@ -196,7 +212,7 @@ class ServeResult(NamedTuple):
 class _Request:
     __slots__ = ("image1", "image2", "key", "flow_init", "want_low",
                  "low_device", "future", "t_submit", "deadline",
-                 "priority", "stream", "seq", "prime")
+                 "priority", "stream", "seq", "prime", "span")
 
     def __init__(self, image1, image2, key, flow_init, want_low,
                  low_device, deadline, priority=None, stream=None,
@@ -218,6 +234,9 @@ class _Request:
         self.stream = stream
         self.seq = seq
         self.prime = prime
+        #: request-tracing span (serving/trace.py) — None whenever the
+        #: scheduler runs without a ledger (tracing off, the default)
+        self.span = None
 
 
 class MicroBatchScheduler:
@@ -280,7 +299,8 @@ class MicroBatchScheduler:
                  metrics_path: Optional[str] = None,
                  feature_cache: bool = False,
                  feature_cache_capacity: int = 256,
-                 ragged: bool = False):
+                 ragged: bool = False,
+                 tracer: Optional[TraceLedger] = None):
         """(Trailing knobs) ``feature_cache=True`` (needs a
         ``RAFTEngine(feature_cache=True)``) arms the cross-frame
         device feature-cache pool: ``submit_cached`` becomes
@@ -303,7 +323,16 @@ class MicroBatchScheduler:
         priorities, pipelining and the accounting identity are
         unchanged — a class is just a coarser bucket key (labelled
         ``BxHxW/ragged``). Default OFF: keys, labels and dispatch are
-        byte-identical to the bucketed path."""
+        byte-identical to the bucketed path.
+
+        ``tracer`` (a :class:`~raft_tpu.serving.trace.TraceLedger`)
+        arms request-scoped tracing: every ACCEPTED request gets a
+        span minted at intake and closed exactly once with the
+        accounting class it was counted under; dispatches get fan-in
+        spans linked to their request spans; spans.jsonl appends under
+        the ledger's sampling knob with always-keep-tail exemplars.
+        Default None: no span objects exist, every path above is
+        bitwise the untraced stack."""
         self.engine = engine
         self.max_queue = int(max_queue)
         self.max_batch = int(max_batch)
@@ -337,6 +366,10 @@ class MicroBatchScheduler:
                 "ragged=True with feature_cache=True is not supported "
                 "yet — the cached signature keeps per-shape buckets")
         self._ragged = bool(ragged)
+        #: request-tracing ledger (serving/trace.py); public so
+        #: sessions (parent chaining) and the registry (intake stamps)
+        #: can reach it duck-typed. None = tracing off, zero overhead.
+        self.tracer = tracer
         self._fcache = (FeatureCachePool(feature_cache_capacity)
                         if feature_cache else None)
         if self._fcache is not None:
@@ -479,7 +512,10 @@ class MicroBatchScheduler:
                     if deadline_s is not None else None)
         req = _Request(image1, image2, key, flow_init, want_low,
                        low_device, deadline, priority)
-        self._enqueue(req, priority)
+        if self.tracer is not None:
+            req.span = self._trace_begin(
+                key, priority, deadline_s, warm=flow_init is not None)
+        self._enqueue_traced(req, priority)
         return req.future
 
     def submit_cached(self, frame, *, stream, seq: int,
@@ -543,7 +579,11 @@ class MicroBatchScheduler:
         req = _Request(None, frame, key, None, False, False, deadline,
                        priority, stream=stream, seq=int(seq),
                        prime=prime)
-        self._enqueue(req, priority)
+        if self.tracer is not None:
+            req.span = self._trace_begin(
+                key, priority, deadline_s, stream=stream, seq=int(seq),
+                prime=prime)
+        self._enqueue_traced(req, priority)
         return req.future
 
     def _intake_guard(self, key) -> None:
@@ -605,6 +645,15 @@ class MicroBatchScheduler:
                         # race window
                         raced=self.metrics.record_cancelled):
                     self.metrics.record_evicted(victim.priority)
+                    if self.tracer is not None \
+                            and victim.span is not None:
+                        # evicted futures fail (counted shed AND
+                        # failed) — the span's class follows the
+                        # counter, the outcome names the real story
+                        self.tracer.close(victim.span, "evicted",
+                                          "failed")
+                else:
+                    self._trace_cancel(victim)
             self._q.append(req)
             if priority == PRIORITY_BATCH:
                 self._seen_batch = True
@@ -613,6 +662,127 @@ class MicroBatchScheduler:
             self.metrics.record_submit(depth=len(self._q),
                                        priority=priority)
             self._cv.notify()
+
+    # -- request tracing (serving/trace.py; every helper is a no-op
+    # when self.tracer is None — the tracing-off hot path pays one
+    # attribute read) -------------------------------------------------------
+
+    def _enqueue_traced(self, req: _Request, priority) -> None:
+        """``_enqueue`` with span hygiene: a request REJECTED at the
+        queue (backpressure, closed) was never accepted — its
+        just-minted span is discarded, never an orphan; an accepted
+        one stamps its trace id onto the returned future (the
+        session-chaining handle)."""
+        if req.span is None:
+            self._enqueue(req, priority)
+            return
+        try:
+            self._enqueue(req, priority)
+        except BaseException:
+            self.tracer.discard(req.span)
+            raise
+        req.future.trace_id = req.span.trace_id
+
+    def _trace_begin(self, key, priority, deadline_s, *, warm=False,
+                     stream=None, seq=None, prime=False):
+        """Mint one accepted request's span at intake: bucket label,
+        priority/deadline, breaker state at admit (``peek`` — the
+        read must not promote a half-open probe), cache identity for
+        cached rows. The registry's thread-local intake stamp
+        (model/variant/canary) and a session's parent link merge in
+        at ``begin``."""
+        fields = {"bucket": self._key_label(key)}
+        if self.namespace is not None:
+            fields["model"] = self.namespace
+        if priority is not None:
+            fields["priority"] = priority
+        if deadline_s is not None:
+            fields["deadline_s"] = deadline_s
+        if warm:
+            fields["warm"] = True
+        if stream is not None:
+            fields["stream"] = str(stream)
+            fields["seq"] = seq
+            if prime:
+                fields["prime"] = True
+        br = self._breakers.get(key)
+        fields["breaker_at_admit"] = (br.peek() if br is not None
+                                      else BREAKER_CLOSED)
+        return self.tracer.begin("request", **fields)
+
+    def _trace_cancel(self, req: _Request) -> None:
+        """Close a span whose caller cancelled the future (reaped at
+        sweep/take/dispatch, or raced into a settle)."""
+        if self.tracer is not None and req.span is not None:
+            self.tracer.close(req.span, "cancelled", "cancelled")
+
+    def _trace_dispatch(self, live: List[_Request], label: str,
+                        bucket, t_disp: float, real_px: int,
+                        padded_px: int, **extra) -> None:
+        """Mint the coalesce fan-in DISPATCH span and link/mark the
+        batch's request spans: one dispatch span carries N request
+        trace ids (bucket/capacity-class key + padding-waste share),
+        each request span gets the back-link, its ``taken`` mark, and
+        its own padding share of the executable's box."""
+        tr = self.tracer
+        if tr is None:
+            return
+        spans = [r.span for r in live if r.span is not None]
+        if not spans:
+            return
+        waste = (round(1.0 - real_px / padded_px, 4) if padded_px
+                 else 0.0)
+        d = tr.begin("dispatch", bucket=label, fan_in=len(live),
+                     capacity=int(bucket[0]), padding_waste=waste,
+                     requests=[s.trace_id for s in spans],
+                     **({"model": self.namespace}
+                        if self.namespace is not None else {}),
+                     **extra)
+        for r in live:
+            if r.span is None:
+                continue
+            px = (r.image2.shape[0] * r.image2.shape[1]
+                  if r.image2 is not None else 0)
+            tr.mark(r.span, "taken", at=t_disp)
+            r.span.linked = d
+            tr.annotate(r.span, dispatch=d.trace_id, fan_in=len(live),
+                        padding_share=(round(px / padded_px, 4)
+                                       if padded_px else 0.0))
+
+    def _trace_mark(self, live: List[_Request], phase: str,
+                    at: Optional[float] = None) -> None:
+        tr = self.tracer
+        if tr is None:
+            return
+        t = at if at is not None else time.monotonic()
+        for r in live:
+            if r.span is not None:
+                tr.mark(r.span, phase, at=t)
+
+    def _trace_span_ctx(self, pending, live: List[_Request]) -> None:
+        """Hand the batch's span context to the engine's PendingBatch
+        so the pipelined completion stage can stamp ``fetch_start``
+        from the pending it actually fetches (duck-typed pendings
+        without the slot are tolerated — the marks just stay on the
+        dispatch path's ``live`` closure)."""
+        if self.tracer is None:
+            return
+        try:
+            pending.span_ctx = [r.span for r in live]
+        except AttributeError:
+            pass
+
+    def _trace_close_dispatch(self, live: List[_Request],
+                              outcome: str) -> None:
+        """Close the batch's linked dispatch span (idempotent — the
+        failure paths close it per-request too, first close wins)."""
+        tr = self.tracer
+        if tr is None:
+            return
+        for r in live:
+            if r.span is not None and r.span.linked is not None:
+                tr.close(r.span.linked, outcome)
+                return
 
     def update_weights(self, variables) -> None:
         """Live checkpoint swap; atomic wrt in-flight micro-batches
@@ -825,6 +995,11 @@ class MicroBatchScheduler:
                     # submitter or the dispatcher
                     raced=self.metrics.record_cancelled):
                 self.metrics.record_deadline_miss(priority=req.priority)
+                if self.tracer is not None and req.span is not None:
+                    self.tracer.close(req.span, "deadline_expired",
+                                      "deadline_missed")
+            else:
+                self._trace_cancel(req)
             return True
         return False
 
@@ -841,6 +1016,7 @@ class MicroBatchScheduler:
         for r in self._q:
             if r.future.cancelled():
                 self.metrics.record_cancelled()
+                self._trace_cancel(r)
             elif self._expire(r, now):
                 pass
             else:
@@ -886,6 +1062,7 @@ class MicroBatchScheduler:
             for r in self._q:
                 if r.future.cancelled():
                     self.metrics.record_cancelled()
+                    self._trace_cancel(r)
                 elif self._expire(r, now):
                     pass
                 else:
@@ -910,13 +1087,37 @@ class MicroBatchScheduler:
         settled (already-done futures — raced by a wedge verdict or a
         late-waking quarantined thread — are skipped, keeping
         submitted == completed + failed + deadline_missed + cancelled
-        exact)."""
+        exact). Tracing armed: each settled request's span closes
+        under the ``failed`` class (outcome = the exception type),
+        and its linked dispatch span closes with it so a wedged batch
+        never orphans its fan-in record; a raced CANCEL closes the
+        span cancelled, any other racer owns the close itself."""
         n = 0
+        tr = self.tracer
         for r in requests:
             if r.future.done():
+                # an already-done future here was settled by a racer
+                # who closed its span — EXCEPT a caller cancel, which
+                # owns nothing: close it (idempotent) or the span
+                # orphans
+                if tr is not None and r.future.cancelled():
+                    self._trace_cancel(r)
                 continue
             if settle_future(r.future, exc):
                 n += 1
+                if tr is not None and r.span is not None:
+                    tr.close(r.span, type(exc).__name__, "failed",
+                             reason=str(exc)[:160])
+            elif tr is not None and r.span is not None \
+                    and r.future.cancelled():
+                self._trace_cancel(r)
+        if tr is not None:
+            # close the batch's linked dispatch span once, whatever
+            # mix of settles/races the loop saw — an all-cancelled
+            # batch must not orphan its fan-in record (idempotent; a
+            # completion racer's "ok" close wins if it got there
+            # first)
+            self._trace_close_dispatch(requests, "failed")
         return n
 
     def _await_pipeline_slot(self) -> None:
@@ -982,6 +1183,11 @@ class MicroBatchScheduler:
                 closed = self._closed
             if self._completion is not None:
                 self._check_completions()
+            if self.tracer is not None:
+                # span records buffer under the leaf lock; the
+                # dispatcher's tick is the serving-time flush point
+                # (close() flushes the rest)
+                self.tracer.flush()
             if key is None:
                 if closed:
                     return
@@ -1259,11 +1465,28 @@ class MicroBatchScheduler:
                 if not r.low_device and not isinstance(low, np.ndarray):
                     low = np.asarray(low)
             if not settle_future(r.future, ServeResult(flows[i], low)):
-                continue  # wedge verdict settled it first
-            self.metrics.record_complete(
-                label, queue_ms=(t_disp - r.t_submit) * 1e3,
-                device_ms=(t_done - t_disp) * 1e3,
-                priority=r.priority)
+                # wedge verdict settled it first (and owns the span
+                # close); a raced caller cancel owns nothing — close
+                # the span cancelled (idempotent either way)
+                if r.future.cancelled():
+                    self._trace_cancel(r)
+                continue
+            queue_ms = (t_disp - r.t_submit) * 1e3
+            device_ms = (t_done - t_disp) * 1e3
+            tail = self.metrics.record_complete(
+                label, queue_ms=queue_ms, device_ms=device_ms,
+                priority=r.priority,
+                trace_id=(r.span.trace_id if r.span is not None
+                          else None))
+            if self.tracer is not None and r.span is not None:
+                # observed_ms: the exact value the latency histogram
+                # binned — serve_trace's top-bucket selection must
+                # reproduce the histogram's membership, not re-derive
+                # it from the span's own (ms-skewed) close clock
+                self.tracer.close(
+                    r.span, "completed", "completed", tail=tail,
+                    observed_ms=round(queue_ms + device_ms, 3))
+        self._trace_close_dispatch(live, "ok")
 
     def _run_completion(self, key, live: List[_Request], pending,
                         job: _DispatchJob, settle) -> None:
@@ -1282,6 +1505,16 @@ class MicroBatchScheduler:
         # fault site (before fn) leaves the handoff stamp running, and
         # a hang in fetch ages from here.
         job.t_start = time.monotonic()
+        if self.tracer is not None:
+            # the pending carries its batch's span context (set at
+            # dispatch): stamp the device-fetch phase edge from the
+            # completion worker that actually blocks on it
+            ctx = getattr(pending, "span_ctx", None)
+            if ctx:
+                t = time.monotonic()
+                for s in ctx:
+                    if s is not None:
+                        self.tracer.mark(s, "fetch_start", at=t)
         try:
             try:
                 outs = pending.fetch()
@@ -1318,6 +1551,9 @@ class MicroBatchScheduler:
                     self._pending_jobs.remove(job)
                 except ValueError:
                     pass   # a wedge verdict removed it already
+            if self.tracer is not None:
+                self.tracer.flush()   # after the lock: I/O stays
+                #                       lock-free (T1)
 
     def _complete_batch(self, key: Tuple[int, int], label: str,
                         live: List[_Request], pending, t_disp: float,
@@ -1341,6 +1577,7 @@ class MicroBatchScheduler:
                 live.append(r)
             else:
                 self.metrics.record_cancelled()
+                self._trace_cancel(r)
         if not live:
             return
         job.batch = live
@@ -1354,14 +1591,17 @@ class MicroBatchScheduler:
             label = "x".join(map(str, bucket))
             with self._cv:
                 depth = len(self._q)
+            # padding-waste gauge: requested pixels vs the padded
+            # pixels the executable actually runs (batch fill + align
+            # pad + bucket fill) — comparable across the bucketed and
+            # ragged paths, shared with the dispatch span
+            real_px = n * h * w
+            padded_px = bucket[0] * bucket[1] * bucket[2]
             self.metrics.record_dispatch(
                 label, filled=n, capacity=bucket[0], depth=depth,
-                # padding-waste gauge: requested pixels vs the padded
-                # pixels the executable actually runs (batch fill +
-                # align pad + bucket fill) — comparable across the
-                # bucketed and ragged paths
-                real_px=n * h * w,
-                padded_px=bucket[0] * bucket[1] * bucket[2])
+                real_px=real_px, padded_px=padded_px)
+            self._trace_dispatch(live, label, bucket, t_disp,
+                                 real_px=real_px, padded_px=padded_px)
             fault_point("serve.request")
             if job.abandoned:
                 # wedge verdict landed while we were stuck above:
@@ -1412,6 +1652,8 @@ class MicroBatchScheduler:
                 gap_ms=gap_ms, assembly_ms=(t_call_end - t_asm0) * 1e3,
                 overlapped=overlapped, h2d_bytes=pending.h2d_bytes,
                 requests=n)
+            self._trace_mark(live, "shipped", at=t_call_end)
+            self._trace_span_ctx(pending, live)
             self._prev_pending = pending
             if job.abandoned:
                 # a wedge verdict landed while the engine call was out
@@ -1425,6 +1667,7 @@ class MicroBatchScheduler:
                     self.metrics.record_failure(n)
                 return
             if self._completion is None:
+                self._trace_mark(live, "fetch_start")
                 self._settle(live, pending.fetch(), label, t_disp, warm)
                 job.outcome = "ok"
                 return
@@ -1477,6 +1720,7 @@ class MicroBatchScheduler:
                 live.append(r)
             else:
                 self.metrics.record_cancelled()
+                self._trace_cancel(r)
         if not live:
             return
         job.batch = live
@@ -1492,11 +1736,16 @@ class MicroBatchScheduler:
             with self._cv:
                 depth = len(self._q)
             shapes = {tuple(r.image1.shape[:2]) for r in live}
+            real_px = sum(r.image1.shape[0] * r.image1.shape[1]
+                          for r in live)
+            padded_px = bucket[0] * bucket[1] * bucket[2]
             self.metrics.record_dispatch(
                 label, filled=n, capacity=bucket[0], depth=depth,
-                real_px=sum(r.image1.shape[0] * r.image1.shape[1]
-                            for r in live),
-                padded_px=bucket[0] * bucket[1] * bucket[2],
+                real_px=real_px, padded_px=padded_px,
+                ragged=True, cross_shape=len(shapes) > 1)
+            self._trace_dispatch(
+                live, label, bucket, t_disp,
+                real_px=real_px, padded_px=padded_px,
                 ragged=True, cross_shape=len(shapes) > 1)
             fault_point("serve.request")
             if job.abandoned:
@@ -1534,6 +1783,8 @@ class MicroBatchScheduler:
                 gap_ms=gap_ms, assembly_ms=(t_call_end - t_asm0) * 1e3,
                 overlapped=overlapped, h2d_bytes=pending.h2d_bytes,
                 requests=n)
+            self._trace_mark(live, "shipped", at=t_call_end)
+            self._trace_span_ctx(pending, live)
             self._prev_pending = pending
             if job.abandoned:
                 n_failed = self._fail_requests(live,
@@ -1545,6 +1796,7 @@ class MicroBatchScheduler:
                 # per-row fetch output matches _settle's (flows, lows)
                 # protocol — the settle/accounting path is shared, not
                 # forked
+                self._trace_mark(live, "fetch_start")
                 self._settle(live, pending.fetch(), label, t_disp, warm)
                 job.outcome = "ok"
                 return
@@ -1592,11 +1844,23 @@ class MicroBatchScheduler:
                                fl)
             res = ServeResult(None if r.prime else flow[i], None)
             if not settle_future(r.future, res):
-                continue  # wedge verdict settled it first
-            self.metrics.record_complete(
-                label, queue_ms=(t_disp - r.t_submit) * 1e3,
-                device_ms=(t_done - t_disp) * 1e3,
-                priority=r.priority)
+                # wedge verdict settled it first (owns the span
+                # close); a raced cancel owns nothing — close here
+                if r.future.cancelled():
+                    self._trace_cancel(r)
+                continue
+            queue_ms = (t_disp - r.t_submit) * 1e3
+            device_ms = (t_done - t_disp) * 1e3
+            tail = self.metrics.record_complete(
+                label, queue_ms=queue_ms, device_ms=device_ms,
+                priority=r.priority,
+                trace_id=(r.span.trace_id if r.span is not None
+                          else None))
+            if self.tracer is not None and r.span is not None:
+                self.tracer.close(
+                    r.span, "completed", "completed", tail=tail,
+                    observed_ms=round(queue_ms + device_ms, 3))
+        self._trace_close_dispatch(live, "ok")
 
     def _complete_cached(self, key, label: str, live: List[_Request],
                          pending, t_disp: float, lh: int, lw: int,
@@ -1626,6 +1890,7 @@ class MicroBatchScheduler:
                 live.append(r)
             else:
                 self.metrics.record_cancelled()
+                self._trace_cancel(r)
         if not live:
             return
         job.batch = live
@@ -1678,6 +1943,19 @@ class MicroBatchScheduler:
                     fi = forward_interpolate_device(slot.flow_low)
                 kept.append(r)
                 slots.append((slot.fmap, slot.ctx, fi))
+            if self.tracer is not None:
+                # feature-cache attribution: whether each row's slot
+                # actually held at assembly (the p99 question "was the
+                # stream warm or re-priming")
+                for r in kept:
+                    if r.span is not None:
+                        self.tracer.annotate(
+                            r.span,
+                            cache="prime" if r.prime else "hit",
+                            warm=not r.prime)
+                for r in missed:
+                    if r.span is not None:
+                        self.tracer.annotate(r.span, cache="miss")
             if missed:
                 n = self._fail_requests(missed, FeatureCacheMiss(
                     "cache slot invalidated while queued (evicted, "
@@ -1698,10 +1976,14 @@ class MicroBatchScheduler:
             # misses must not inflate the warm-video A/B numbers
             with self._cv:
                 depth = len(self._q)
+            real_px = len(live) * h * w
+            padded_px = bucket[0] * bucket[1] * bucket[2]
             self.metrics.record_dispatch(
                 label, filled=len(live), capacity=bucket[0],
-                depth=depth, real_px=len(live) * h * w,
-                padded_px=bucket[0] * bucket[1] * bucket[2])
+                depth=depth, real_px=real_px, padded_px=padded_px)
+            self._trace_dispatch(live, label, bucket, t_disp,
+                                 real_px=real_px, padded_px=padded_px,
+                                 cached=True)
             prev = self._prev_pending
             overlapped = prev is not None and prev.t_ready is None
             t_asm0 = time.monotonic()
@@ -1718,6 +2000,8 @@ class MicroBatchScheduler:
                 gap_ms=gap_ms, assembly_ms=(t_call_end - t_asm0) * 1e3,
                 overlapped=overlapped, h2d_bytes=pending.h2d_bytes,
                 requests=len(live))
+            self._trace_mark(live, "shipped", at=t_call_end)
+            self._trace_span_ctx(pending, live)
             self._prev_pending = pending
             if job.abandoned:
                 n = self._fail_requests(live, self._wedge_error(key))
@@ -1725,6 +2009,7 @@ class MicroBatchScheduler:
                     self.metrics.record_failure(n)
                 return
             if self._completion is None:
+                self._trace_mark(live, "fetch_start")
                 self._settle_cached(key, live, pending.fetch(), label,
                                     t_disp, lh, lw, ver)
                 job.outcome = "ok"
@@ -1757,7 +2042,11 @@ class MicroBatchScheduler:
         return len(self.engine._compiled)
 
     def write_metrics(self, path: Optional[str] = None) -> Dict:
-        """Dump a metrics snapshot on demand (appends a jsonl line)."""
+        """Dump a metrics snapshot on demand (appends a jsonl line).
+        With tracing armed the span buffer flushes first, so the
+        snapshot's ``tail_exemplars`` refs resolve in spans.jsonl."""
+        if self.tracer is not None:
+            self.tracer.flush()
         return self.metrics.write_snapshot(
             executables=self.executable_count(), path=path)
 
@@ -1777,9 +2066,21 @@ class MicroBatchScheduler:
                 exc = SchedulerClosed("dropped by no-drain close")
                 while self._q:
                     r = self._q.popleft()
-                    if not r.future.done() \
-                            and settle_future(r.future, exc):
-                        n += 1
+                    if r.future.done() or not settle_future(r.future,
+                                                            exc):
+                        # a queued future can only be done here by a
+                        # caller cancel no sweep got to: count (and
+                        # close the span as) the cancel it was — the
+                        # identity must survive shutdown too
+                        if r.future.cancelled():
+                            self.metrics.record_cancelled()
+                            self._trace_cancel(r)
+                        continue
+                    n += 1
+                    if self.tracer is not None \
+                            and r.span is not None:
+                        self.tracer.close(r.span, "SchedulerClosed",
+                                          "failed")
                 self.metrics.record_failure(n)
             self._cv.notify_all()
         self._worker.join(timeout)
@@ -1812,6 +2113,10 @@ class MicroBatchScheduler:
             # snapshots) — the pool must not pin per-stream device
             # arrays past close
             self.flush_feature_cache("close")
+        if self.tracer is not None:
+            # every accepted span settled above (drain or fail):
+            # spans.jsonl is complete once close returns
+            self.tracer.flush()
         if first and self.metrics.path:
             self.metrics.write_snapshot(
                 executables=self.executable_count())

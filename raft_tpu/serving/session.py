@@ -171,6 +171,30 @@ class VideoSession:
         #: collide on seq and silently correlate one video's frame
         #: against the other's cached features)
         self._stream = f"stream-{next(_SESSION_IDS)}"
+        #: request tracing (serving/trace.py): the previous submit's
+        #: trace id — frame N's span links frame N−1's, so the
+        #: stream's whole recurrence (primes and re-primes included)
+        #: is one walkable chain. None whenever the scheduler runs
+        #: untraced.
+        self._last_trace: Optional[str] = None
+
+    def _trace_parent(self):
+        """Arm the next submit's parent link (tracing armed); returns
+        the ledger or None — the same duck-typed read off a plain
+        scheduler or a registry."""
+        tr = getattr(self._sched, "tracer", None)
+        if tr is not None and self._last_trace is not None:
+            tr.set_parent(self._last_trace)
+        return tr
+
+    def _trace_unparent(self):
+        """Clear an armed-but-unconsumed parent link after a submit
+        REJECTED before the mint (backpressure/breaker at intake) —
+        a stale stamp on the thread must never chain an unrelated
+        later span into this stream."""
+        tr = getattr(self._sched, "tracer", None)
+        if tr is not None:
+            tr.set_parent(None)
 
     def _harvest(self) -> None:
         """Settle the previous pair — the recurrence is sequential per
@@ -233,18 +257,25 @@ class VideoSession:
                     flow_init = None
         effective_deadline = (self.deadline_s if deadline_s is None
                               else deadline_s)
+        tr = self._trace_parent()
         try:
-            fut = self._sched.submit(
-                prev, frame, deadline_s=effective_deadline,
-                flow_init=flow_init, want_low=self.warm_start,
-                low_device=self.device_state, **self._submit_kw)
-        except self._retryable as exc:
-            fut = self._retry_submit(prev, frame, effective_deadline,
-                                     exc)
-        else:
-            if flow_init is not None:
-                self.warm_submits += 1
+            try:
+                fut = self._sched.submit(
+                    prev, frame, deadline_s=effective_deadline,
+                    flow_init=flow_init, want_low=self.warm_start,
+                    low_device=self.device_state, **self._submit_kw)
+            except self._retryable as exc:
+                fut = self._retry_submit(prev, frame,
+                                         effective_deadline, exc)
+            else:
+                if flow_init is not None:
+                    self.warm_submits += 1
+        except BaseException:
+            self._trace_unparent()
+            raise
         self._pending = fut
+        if tr is not None:
+            self._last_trace = getattr(fut, "trace_id", None)
         return fut
 
     def _variant_moved(self) -> bool:
@@ -358,24 +389,41 @@ class VideoSession:
         re-raises the ORIGINAL rejection — the cached analog of
         ``_retry_submit``. No forced cold restart here: warmth is
         decided pool-side at dispatch, and the slot's seq/version
-        validity already guards anything a backoff could stale."""
+        validity already guards anything a backoff could stale.
+        Tracing armed: every submit (pairs, primes, re-primes) links
+        the stream's previous trace — a cold restart stays ON the
+        chain, visible by its ``prime`` annotation, so serve_trace
+        can attribute the re-prime round trip to the pair it
+        delayed."""
+        tr = self._trace_parent()
         try:
-            return self._sched.submit_cached(
-                frame, stream=self._stream, seq=seq, prime=prime,
-                deadline_s=deadline_s, **self._submit_kw)
-        except self._retryable as exc:
-            delays = self._mk_delays()
-            while self.retries_used < self.retry_budget:
-                self.retries_used += 1
-                self._retry_sleep(next(delays))
-                try:
-                    return self._sched.submit_cached(
-                        frame, stream=self._stream, seq=seq,
-                        prime=prime, deadline_s=deadline_s,
-                        **self._submit_kw)
-                except self._retryable:
-                    continue
-            raise exc
+            fut = None
+            try:
+                fut = self._sched.submit_cached(
+                    frame, stream=self._stream, seq=seq, prime=prime,
+                    deadline_s=deadline_s, **self._submit_kw)
+            except self._retryable as exc:
+                delays = self._mk_delays()
+                while self.retries_used < self.retry_budget:
+                    self.retries_used += 1
+                    self._retry_sleep(next(delays))
+                    try:
+                        self._trace_parent()
+                        fut = self._sched.submit_cached(
+                            frame, stream=self._stream, seq=seq,
+                            prime=prime, deadline_s=deadline_s,
+                            **self._submit_kw)
+                        break
+                    except self._retryable:
+                        continue
+                if fut is None:
+                    raise exc
+        except BaseException:
+            self._trace_unparent()
+            raise
+        if tr is not None:
+            self._last_trace = getattr(fut, "trace_id", None)
+        return fut
 
     def _retry_submit(self, prev, frame,
                       deadline_s: Optional[float], original):
@@ -395,6 +443,8 @@ class VideoSession:
             self.retries_used += 1
             self._retry_sleep(next(delays))
             try:
+                self._trace_parent()  # the retried (cold) pair stays
+                #                       on the stream's trace chain
                 return self._sched.submit(
                     prev, frame, deadline_s=deadline_s,
                     flow_init=None, want_low=self.warm_start,

@@ -1,0 +1,196 @@
+"""The ONE schema-assert test over every metrics.jsonl/spans.jsonl
+record kind (serving/schema.py).
+
+Until ISSUE 14 each test re-declared its slice of the record schema
+inline; this drill drives the REAL emitters — a wedged scheduler with
+breakers, a feature-cache flush, a full registry rollout lifecycle
+(deploy/promote/rollback/failed deploy/close), guardian verdicts
+(promote, rollback, failed decision, loop error), and a traced drill
+writing span records — then validates every line against the single
+registry and asserts coverage both ways: every emitted record
+conforms, every declared event kind was actually produced.
+"""
+
+import json
+import random
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.serving import schema
+from raft_tpu.serving.guardian import GuardianPolicy, SLOGuardian
+from raft_tpu.serving.metrics import ServingMetrics
+from raft_tpu.serving.registry import DeployError, ModelRegistry
+from raft_tpu.serving.resilience import DispatchWedged
+from raft_tpu.serving.scheduler import MicroBatchScheduler
+from raft_tpu.serving.trace import TraceLedger
+from raft_tpu.testing import faults
+from tests.test_guardian import _FakeRegistry, _blk
+from tests.test_registry import _WarmFakeEngine
+from tests.test_scheduler import _FakeEngine
+
+Z = np.zeros((32, 32, 3), np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm()
+
+
+def _lines(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def _drive_scheduler_events(mpath, spath):
+    """serving_state / breaker_* / dispatch_wedged /
+    thread_quarantined / cache_flush / serving snapshots / spans —
+    the real wedge-and-recover flow from the resilience drills, with
+    a feature-cache pool and a trace ledger armed."""
+    eng = _FakeEngine()
+    eng.feature_cache = True              # pool only — no XLA needed
+    faults.arm([{"site": "serve.request", "kind": "hang",
+                 "hang_s": 1.0, "count": 1}])
+    sched = MicroBatchScheduler(
+        eng, gather_window_s=0.0, dispatch_timeout_s=0.3,
+        breaker_failures=1, breaker_backoff_s=0.2,
+        breaker_backoff_max_s=0.2, breaker_rng=random.Random(0),
+        feature_cache=True, metrics_path=mpath,
+        tracer=TraceLedger(spath))
+    wedged = sched.submit(Z, Z)
+    with pytest.raises(DispatchWedged):
+        wedged.result(timeout=10)
+    faults.disarm()
+    # half-open probe closes the breaker (breaker_closed event)
+    t_end = time.monotonic() + 20.0
+    while time.monotonic() < t_end:
+        try:
+            sched.submit(Z, Z).result(timeout=10)
+            break
+        except Exception:
+            time.sleep(0.05)
+    sched.flush_feature_cache("drill")    # cache_flush event
+    sched.close(drain=True)               # snapshot + span flush
+
+
+def _drive_registry_events(mpath):
+    """model_state / model_deploy / model_promote / model_rollback /
+    model_deploy_failed / registry_closed, through real rollouts."""
+    reg = ModelRegistry(metrics_path=mpath, gather_window_s=0.0)
+    reg.add_model("m", {}, RAFTConfig(), engine=_WarmFakeEngine())
+    reg.deploy("m", {}, engine=_WarmFakeEngine(), canary_fraction=0.5)
+    reg.promote("m")
+    reg.deploy("m", {}, engine=_WarmFakeEngine(), canary_fraction=0.5)
+    reg.rollback("m")
+    faults.arm([{"site": "registry.load", "kind": "raise", "count": 1}])
+    with pytest.raises(DeployError):
+        reg.deploy("m", {}, engine=None, canary_fraction=0.5)
+    faults.disarm()
+    reg.close()
+
+
+def _drive_guardian_events(mpath):
+    """guardian_bake_start / guardian_promote / guardian_rollback /
+    guardian_decision_failed / guardian_error via the real guardian
+    over scripted registries + synthetic snapshots (the
+    test_guardian determinism pattern)."""
+    policy = GuardianPolicy(bake_window_s=1.0, min_requests=1)
+    metrics = ServingMetrics(mpath, namespace="guardian")
+
+    # promote: clean bake past the window
+    fake = _FakeRegistry()
+    clock = [0.0]
+    snaps = [{"m": {"live": _blk(), "canary": _blk(model="m@v2")}},
+             {"m": {"live": _blk(completed=30),
+                    "canary": _blk(completed=30, model="m@v2")}}]
+    it1 = iter(snaps)
+    g = SLOGuardian(fake, policy, clock=lambda: clock[0],
+                    reader=lambda: next(it1), metrics=metrics)
+    g.tick()                              # bake_start
+    clock[0] = 2.0
+    g.tick()                              # clean -> guardian_promote
+    assert fake.actions == [("promote", "m")]
+
+    # rollback: wedge breach in the canary window
+    fake2 = _FakeRegistry()
+    snaps2 = [{"m": {"live": _blk(), "canary": _blk(model="m@v3")}},
+              {"m": {"live": _blk(completed=30),
+                     "canary": _blk(completed=30, wedged=2,
+                                    model="m@v3")}}]
+    it2 = iter(snaps2 + [snaps2[-1]])
+    g2 = SLOGuardian(fake2, policy, clock=lambda: clock[0],
+                     reader=lambda: next(it2), metrics=metrics)
+    clock[0] = 0.0
+    g2.tick()
+    clock[0] = 0.5
+    g2.tick()                             # breach -> guardian_rollback
+    assert fake2.actions == [("rollback", "m")]
+
+    # decision_failed: the registry refuses the verdict
+    fake3 = _FakeRegistry()
+    fake3.raise_on_action = RuntimeError("operator got there first")
+    it3 = iter([{"m": {"live": _blk(),
+                       "canary": _blk(model="m@v4")}},
+                {"m": {"live": _blk(completed=30),
+                       "canary": _blk(completed=30, wedged=2,
+                                      model="m@v4")}}])
+    g3 = SLOGuardian(fake3, policy, clock=lambda: clock[0],
+                     reader=lambda: next(it3), metrics=metrics)
+    clock[0] = 0.0
+    g3.tick()
+    clock[0] = 0.5
+    g3.tick()                             # guardian_decision_failed
+
+    # guardian_error: a reader that raises inside the polling loop
+    def boom():
+        raise RuntimeError("reader down")
+
+    g4 = SLOGuardian(_FakeRegistry(), policy, reader=boom,
+                     poll_s=0.01, metrics=metrics).start()
+    time.sleep(0.1)
+    g4.stop()
+    assert g4.errors >= 1
+
+
+def test_every_record_kind_validates_and_is_covered(tmp_path):
+    mpath = str(tmp_path / "metrics.jsonl")
+    spath = str(tmp_path / "spans.jsonl")
+    _drive_scheduler_events(mpath, spath)
+    _drive_registry_events(mpath)
+    _drive_guardian_events(mpath)
+
+    recs = _lines(mpath) + _lines(spath)
+    problems = schema.validate_lines(recs)
+    assert problems == []
+
+    seen_events = {r["event"] for r in recs
+                   if r.get("kind") == "serving_event"}
+    missing = set(schema.EVENT_FIELDS) - seen_events
+    assert not missing, \
+        f"declared event kinds never emitted by the drill: {missing}"
+    undeclared = seen_events - set(schema.EVENT_FIELDS)
+    assert not undeclared    # validate_lines already failed these
+    kinds = {r.get("kind") for r in recs}
+    assert kinds == set(schema.RECORD_KINDS)
+    spans = {r["span"] for r in recs if r.get("kind") == "span"}
+    assert spans == set(schema.SPAN_KINDS)
+
+
+def test_validator_rejects_drift():
+    assert schema.validate_record({"kind": "mystery"})
+    bad_event = {"kind": "serving_event", "event": "breaker_open",
+                 "time": 0.0}
+    assert any("missing" in p
+               for p in schema.validate_record(bad_event))
+    assert any("undeclared" in p for p in schema.validate_record(
+        {"kind": "serving_event", "event": "brand_new_event",
+         "time": 0.0}))
+    bad_span = {"kind": "span", "span": "request", "trace_id": "r-1",
+                "time": 0.0, "outcome": "completed", "class": "nope",
+                "total_ms": 1.0, "tail": False, "bucket": "b",
+                "phases": {}}
+    assert any("class" in p for p in schema.validate_record(bad_span))
+    good = dict(bad_span, **{"class": "completed"})
+    assert schema.validate_record(good) == []

@@ -1,0 +1,569 @@
+"""Request-scoped tracing (ISSUE 14): the span ledger across the
+serving stack.
+
+Four layers:
+
+- ledger units (serving/trace.py): deterministic sampling,
+  always-keep-tail/failure retention, idempotent exactly-once close,
+  discard-on-reject, buffered lock-free flush, jsonl record shape;
+- scheduler integration: tracing OFF is the bitwise default (no span
+  objects, no file, no new summary keys); tracing ON mints one span
+  per ACCEPTED request and closes it on the path that settled its
+  future — completed/failed/deadline/cancelled/evicted — with
+  dispatch fan-in spans, phase marks, breaker-at-admit and
+  feature-cache annotations, and session chains walkable via parent
+  links (registry spans additionally stamped model/variant/canary);
+- THE acceptance drill: seeded chaos (wedge, shed, deadline,
+  raise) at pipeline_depth=2 closes exactly one span per accepted
+  request, zero orphans, with outcome tags reconciling
+  bucket-for-bucket against submitted == completed + failed +
+  deadline_missed + cancelled;
+- serve_trace read-back: phase attribution over the tail exemplars
+  reproduces the metrics histogram's top-bucket membership, and a
+  timeline walk reconstructs dispatch fan-in + session chain.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.serving.registry import ModelRegistry
+from raft_tpu.serving.scheduler import (PRIORITY_BATCH,
+                                        PRIORITY_INTERACTIVE,
+                                        DeadlineExceeded,
+                                        MicroBatchScheduler,
+                                        SchedulerClosed)
+from raft_tpu.serving.session import VideoSession
+from raft_tpu.serving.trace import (SPAN_CLASSES, TraceLedger,
+                                    sample_fraction)
+from raft_tpu.testing import faults
+from tests.test_registry import _WarmFakeEngine
+from tests.test_scheduler import _FakeEngine
+
+Z = np.zeros((32, 32, 3), np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def small_setup():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.models import RAFT
+
+    cfg = RAFTConfig(small=True)
+    img = jnp.zeros((1, 32, 32, 3))
+    variables = RAFT(cfg).init(jax.random.PRNGKey(0), img, img,
+                               iters=1)
+    return cfg, variables
+
+
+@pytest.fixture(scope="module")
+def engine(small_setup):
+    """One warm-start engine shared by the real-stack drills here
+    (same two-bucket envelope as test_scheduler's)."""
+    from raft_tpu.serving.engine import RAFTEngine
+    from tests.test_scheduler import BUCKET_BATCH, SHAPES
+
+    cfg, variables = small_setup
+    return RAFTEngine(variables, cfg, iters=1,
+                      envelope=[(BUCKET_BATCH, h, w)
+                                for h, w in SHAPES],
+                      precompile=True, warm_start=True)
+
+
+def _spans(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def _requests(path):
+    return [r for r in _spans(path) if r["span"] == "request"]
+
+
+class TestLedgerUnits:
+    def test_exactly_once_close_and_counters(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        led = TraceLedger(path)
+        s = led.begin("request", bucket="32x32")
+        assert led.open_count() == 1
+        assert led.close(s, "completed", "completed") is True
+        assert led.close(s, "failed", "failed") is False  # idempotent
+        assert led.open_count() == 0
+        assert led.snapshot()["closed"] == 1
+        led.flush()
+        recs = _spans(path)
+        assert len(recs) == 1
+        assert recs[0]["class"] == "completed"
+        assert recs[0]["kind"] == "span"
+
+    def test_discard_never_writes_never_orphans(self, tmp_path):
+        led = TraceLedger(str(tmp_path / "s.jsonl"))
+        s = led.begin("request")
+        led.discard(s)
+        assert led.open_count() == 0
+        led.flush()
+        assert not os.path.exists(led.path) \
+            or not _spans(led.path)
+        assert led.snapshot()["discarded"] == 1
+
+    def test_sampling_is_deterministic_and_keeps_tail_and_failures(
+            self, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        led = TraceLedger(path, sample_rate=0.0)  # drop everything...
+        kept = []
+        for i in range(20):
+            s = led.begin("request", bucket="b")
+            kept.append(led.close(s, "completed", "completed"))
+        assert not any(kept)            # ...sampled out at rate 0
+        t = led.begin("request", bucket="b")
+        assert led.close(t, "completed", "completed", tail=True)
+        f = led.begin("request", bucket="b")
+        assert led.close(f, "RuntimeError", "failed")
+        d = led.begin("request", bucket="b")
+        assert led.close(d, "deadline_expired", "deadline_missed")
+        led.flush()
+        recs = _requests(path)
+        assert {r["class"] for r in recs} == {"completed", "failed",
+                                             "deadline_missed"}
+        assert [r for r in recs if r["tail"]]
+        # the sample hash is a pure function of the id
+        assert sample_fraction("r-1") == sample_fraction("r-1")
+        assert 0.0 <= sample_fraction("r-2") < 1.0
+
+    def test_dispatch_span_kept_only_with_a_written_child(
+            self, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        led = TraceLedger(path, sample_rate=0.0)
+        r1 = led.begin("request", bucket="b")
+        d = led.begin("dispatch", bucket="b", fan_in=1, capacity=1,
+                      padding_waste=0.0, requests=[r1.trace_id])
+        r1.linked = d
+        led.close(r1, "completed", "completed")   # sampled out
+        led.close(d, "ok")
+        r2 = led.begin("request", bucket="b")
+        d2 = led.begin("dispatch", bucket="b", fan_in=1, capacity=1,
+                       padding_waste=0.0, requests=[r2.trace_id])
+        r2.linked = d2
+        led.close(r2, "completed", "completed", tail=True)  # kept
+        led.close(d2, "ok")
+        led.flush()
+        disp = [r for r in _spans(path) if r["span"] == "dispatch"]
+        assert [r["trace_id"] for r in disp] == [d2.trace_id]
+        assert led.open_count() == 0
+
+    def test_flush_is_buffered_and_resilient(self, tmp_path):
+        path = str(tmp_path / "sub" / "s.jsonl")
+        led = TraceLedger(path)
+        led.close(led.begin("request", bucket="b"), "completed",
+                  "completed")
+        assert not os.path.exists(path)   # close never does I/O
+        assert led.flush() == 1
+        assert led.flush() == 0           # drained
+        assert len(_spans(path)) == 1
+
+    def test_bad_sample_rate_rejected(self):
+        with pytest.raises(ValueError, match="sample_rate"):
+            TraceLedger(None, sample_rate=1.5)
+
+    def test_discard_restores_the_consumed_parent_link(self):
+        """A rollout-raced registry submit mints (consuming the
+        session's parent link), hits SchedulerClosed, discards, and
+        re-routes to live — the re-routed mint must still chain."""
+        led = TraceLedger(None)
+        led.set_parent("r-0")
+        s = led.begin("request")
+        assert s.fields["parent"] == "r-0"
+        led.discard(s)
+        s2 = led.begin("request")
+        assert s2.fields["parent"] == "r-0"
+
+    def test_intake_stamp_and_parent_are_consumed_once(self):
+        led = TraceLedger(None)
+        led.stamp_intake(model="m", variant="v1", canary=False)
+        led.set_parent("r-0")
+        s1 = led.begin("request")
+        assert s1.fields["model"] == "m" and s1.fields["parent"] == "r-0"
+        s2 = led.begin("request")
+        assert "model" not in s2.fields and "parent" not in s2.fields
+
+
+class TestSchedulerTracing:
+    def test_off_is_the_default_and_leaves_no_trace(self):
+        sched = MicroBatchScheduler(_FakeEngine(), gather_window_s=0.0)
+        assert sched.tracer is None
+        fut = sched.submit(Z, Z)
+        fut.result(timeout=30)
+        assert not hasattr(fut, "trace_id")
+        sched.close()
+
+    def test_completed_spans_with_phases_and_fan_in(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        tr = TraceLedger(path)
+        sched = MicroBatchScheduler(_FakeEngine(), gather_window_s=0.05,
+                                    tracer=tr, pipeline_depth=2)
+        futs = [sched.submit(Z, Z) for _ in range(4)]
+        for f in futs:
+            f.result(timeout=30)
+        sched.close()
+        assert tr.open_count() == 0
+        recs = _spans(path)
+        reqs = [r for r in recs if r["span"] == "request"]
+        disp = [r for r in recs if r["span"] == "dispatch"]
+        assert len(reqs) == 4 and disp
+        ids = {r["trace_id"] for r in reqs}
+        assert ids == {getattr(f, "trace_id") for f in futs}
+        for r in reqs:
+            assert r["class"] == "completed"
+            assert r["breaker_at_admit"] == "closed"
+            assert set(r["phases"]) >= {"queue_ms", "assembly_ms",
+                                        "device_ms", "fetch_ms"}
+            assert r["dispatch"] in {d["trace_id"] for d in disp}
+        # the fan-in record carries every request it coalesced
+        covered = {rid for d in disp for rid in d["requests"]}
+        assert covered == ids
+        for d in disp:
+            assert d["fan_in"] == len(d["requests"])
+            assert 0.0 <= d["padding_waste"] < 1.0
+
+    def test_outcome_classes_deadline_failed_cancelled_closed(
+            self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        tr = TraceLedger(path)
+        eng = _FakeEngine()
+        eng.hang_shapes[(40, 40)] = 0.4     # keeps the queue busy
+        eng.fail_shapes.add((48, 48))
+        sched = MicroBatchScheduler(eng, gather_window_s=0.0,
+                                    tracer=tr)
+        blocker = sched.submit(np.zeros((40, 40, 3), np.float32),
+                               np.zeros((40, 40, 3), np.float32))
+        # queued behind the hang: one expires, one is cancelled
+        doomed = sched.submit(Z, Z, deadline_s=0.01)
+        cancelled = sched.submit(Z, Z)
+        time.sleep(0.05)
+        cancelled.cancel()
+        failed = sched.submit(np.zeros((48, 48, 3), np.float32),
+                              np.zeros((48, 48, 3), np.float32))
+        blocker.result(timeout=30)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=30)
+        with pytest.raises(RuntimeError, match="device error"):
+            failed.result(timeout=30)
+        survivor = sched.submit(Z, Z)
+        survivor.result(timeout=30)
+        sched.close()
+        assert tr.open_count() == 0
+        by_id = {r["trace_id"]: r for r in _requests(path)}
+        assert by_id[doomed.trace_id]["class"] == "deadline_missed"
+        assert by_id[doomed.trace_id]["outcome"] == "deadline_expired"
+        assert by_id[cancelled.trace_id]["class"] == "cancelled"
+        assert by_id[failed.trace_id]["class"] == "failed"
+        assert by_id[failed.trace_id]["outcome"] == "RuntimeError"
+        assert by_id[survivor.trace_id]["class"] == "completed"
+        for r in by_id.values():
+            assert r["class"] in SPAN_CLASSES
+
+    def test_no_drain_close_and_eviction_tag_spans(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        tr = TraceLedger(path)
+        eng = _FakeEngine()
+        eng.hang_shapes[(40, 40)] = 0.6
+        sched = MicroBatchScheduler(eng, gather_window_s=0.0,
+                                    max_queue=2, tracer=tr)
+        blocker = sched.submit(np.zeros((40, 40, 3), np.float32),
+                               np.zeros((40, 40, 3), np.float32))
+        time.sleep(0.1)                   # dispatcher takes the hang
+        victim = sched.submit(Z, Z, priority=PRIORITY_BATCH)
+        survivor = sched.submit(Z, Z, priority=PRIORITY_BATCH)
+        # full queue: the interactive arrival evicts the NEWEST batch
+        evictor = sched.submit(Z, Z, priority=PRIORITY_INTERACTIVE)
+        assert survivor.done()            # shed-batch-first took it
+        sched.close(drain=False)
+        assert tr.open_count() == 0
+        by_id = {r["trace_id"]: r for r in _requests(path)}
+        assert by_id[survivor.trace_id]["outcome"] == "evicted"
+        assert by_id[survivor.trace_id]["class"] == "failed"
+        # victim + evictor were dropped by the no-drain close (or
+        # served if the dispatcher got there first) — every accepted
+        # span closed either way
+        for fut in (blocker, victim, evictor):
+            assert by_id[fut.trace_id]["class"] in SPAN_CLASSES
+
+    def test_rejected_submits_mint_no_orphan(self, tmp_path):
+        tr = TraceLedger(str(tmp_path / "s.jsonl"))
+        eng = _FakeEngine()
+        eng.hang_shapes[(40, 40)] = 0.5
+        sched = MicroBatchScheduler(eng, gather_window_s=0.0,
+                                    max_queue=1, tracer=tr)
+        from raft_tpu.serving.scheduler import BackpressureError
+        sched.submit(np.zeros((40, 40, 3), np.float32),
+                     np.zeros((40, 40, 3), np.float32))
+        time.sleep(0.1)                   # dispatcher takes the hang
+        sched.submit(Z, Z)                # fills the one queue slot
+        with pytest.raises(BackpressureError):
+            sched.submit(Z, Z)            # shed — span discarded
+        sched.close()
+        snap = tr.snapshot()
+        assert snap["discarded"] == 1
+        assert snap["open"] == 0
+
+
+class TestSessionAndRegistryTracing:
+    def test_session_chain_is_walkable(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        tr = TraceLedger(path)
+        sched = MicroBatchScheduler(_WarmFakeEngine(),
+                                    gather_window_s=0.0, tracer=tr)
+        sess = VideoSession(sched)
+        rng = np.random.RandomState(0)
+        futs = [sess.submit_frame(
+            rng.rand(32, 32, 3).astype(np.float32))
+            for _ in range(4)]
+        sess.drain()
+        sched.close()
+        pairs = [f for f in futs if f is not None]
+        assert len(pairs) == 3
+        by_id = {r["trace_id"]: r for r in _requests(path)}
+        # frame N links frame N-1: the recurrence is one chain
+        assert by_id[pairs[1].trace_id]["parent"] == pairs[0].trace_id
+        assert by_id[pairs[2].trace_id]["parent"] == pairs[1].trace_id
+        assert "parent" not in by_id[pairs[0].trace_id]
+
+    def test_registry_stamps_model_variant_canary(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        reg = ModelRegistry(trace_path=path, gather_window_s=0.0)
+        reg.add_model("m", {}, RAFTConfig(), engine=_WarmFakeEngine())
+        live_fut = reg.submit(Z, Z, model="m")
+        live_fut.result(timeout=30)
+        reg.deploy("m", {}, engine=_WarmFakeEngine(),
+                   canary_fraction=1.0)
+        can_fut = reg.submit(Z, Z, model="m")
+        can_fut.result(timeout=30)
+        reg.promote("m")
+        reg.close()
+        assert reg.tracer.open_count() == 0
+        by_id = {r["trace_id"]: r for r in _requests(path)}
+        live_span = by_id[live_fut.trace_id]
+        can_span = by_id[can_fut.trace_id]
+        assert live_span["model"] == can_span["model"] == "m"
+        assert live_span["variant"] == "v1"
+        assert live_span["canary"] is False
+        assert can_span["variant"] == "v2"
+        assert can_span["canary"] is True
+
+    def test_cached_spans_annotate_prime_and_hit(self, tmp_path):
+        pytest.importorskip("jax")
+        import jax
+        import jax.numpy as jnp
+
+        from raft_tpu.models import RAFT
+        from raft_tpu.serving.engine import RAFTEngine
+
+        cfg = RAFTConfig(small=True)
+        img = jnp.zeros((1, 32, 32, 3))
+        variables = RAFT(cfg).init(jax.random.PRNGKey(0), img, img,
+                                   iters=1)
+        eng = RAFTEngine(variables, cfg, iters=1, envelope=[(1, 32, 32)],
+                         precompile=False, warm_start=True,
+                         feature_cache=True)
+        path = str(tmp_path / "spans.jsonl")
+        tr = TraceLedger(path)
+        sched = MicroBatchScheduler(eng, gather_window_s=0.0,
+                                    feature_cache=True, tracer=tr)
+        sess = VideoSession(sched, feature_cache=True)
+        rng = np.random.RandomState(0)
+        futs = [sess.submit_frame(
+            rng.rand(32, 32, 3).astype(np.float32))
+            for _ in range(3)]
+        for f in futs:
+            if f is not None:
+                f.result(timeout=120)
+        sess.drain()
+        sched.close()
+        assert tr.open_count() == 0
+        reqs = _requests(path)
+        primes = [r for r in reqs if r.get("prime")]
+        hits = [r for r in reqs if r.get("cache") == "hit"]
+        assert len(primes) == 1 and primes[0]["cache"] == "prime"
+        assert len(hits) == 2
+        # pair spans chain through the prime — the warm recurrence is
+        # one walkable chain, stream identity on every hop
+        for r in reqs:
+            assert r["bucket"].endswith("/cache")
+            assert "stream" in r and "seq" in r
+        chained = [r for r in reqs if r.get("parent")]
+        assert len(chained) == 2
+
+
+class TestChaosSpanAccountingIdentity:
+    def test_chaos_drill_zero_orphans_and_identity(self, tmp_path,
+                                                   small_setup):
+        """THE acceptance drill: seeded randomized fault plans (wedge
+        hangs, raises, deadline pressure) at pipeline_depth=2 over
+        the real engine — spans.jsonl closes exactly ONE span per
+        accepted request, zero orphans, and the outcome-tag classes
+        reconcile bucket-for-bucket against the accounting identity's
+        counters, round totals included."""
+        cfg, variables = small_setup
+        from raft_tpu.cli.serve_bench import run_chaos_drill
+        from tests.test_scheduler import BUCKET_BATCH, SHAPES
+
+        path = str(tmp_path / "spans.jsonl")
+        summary = run_chaos_drill(
+            variables, cfg, shapes=SHAPES, rounds=2, requests=8,
+            submitters=2, bucket_batch=BUCKET_BATCH, iters=1,
+            dispatch_timeout_s=0.4, hang_s=0.8, breaker_failures=1,
+            breaker_backoff_s=0.15, breaker_backoff_max_s=0.6,
+            recover_s=30.0, seed=11, pipeline_depth=2,
+            deadline_s=20.0, trace_path=path)
+        assert summary["violations"] == []
+        assert summary["totals"]["wedged_dispatches"] >= 1
+        ledger = summary["trace"]
+        assert ledger["open"] == 0 and ledger["buffered"] == 0
+        reqs = _requests(path)
+        # exactly one closed span per accepted request, all rounds
+        accounting = [p["tail_exemplars"]["accounting"]
+                      for p in summary["per_round"]]
+        submitted = sum(a["submitted"] for a in accounting)
+        assert len(reqs) == submitted
+        assert len({r["trace_id"] for r in reqs}) == len(reqs)
+        # bucket-for-bucket reconciliation against the identity
+        by_class = {c: 0 for c in SPAN_CLASSES}
+        for r in reqs:
+            by_class[r["class"]] += 1
+        for cls in SPAN_CLASSES:
+            assert by_class[cls] == sum(a[cls] for a in accounting), \
+                f"span class {cls} diverged from its counter"
+        # wedge collateral is attributed, not anonymous: every drill
+        # future the verdicts failed has a span saying so (recovery
+        # probes may add more — they are accepted requests too)
+        wedged_spans = [r for r in reqs
+                        if r["outcome"] == "DispatchWedged"]
+        assert len(wedged_spans) >= sum(
+            p["failed_wedged"] for p in summary["per_round"])
+        # per-round blocks carry their OWN refs/accounting; the
+        # whole-file attribution lives once at the summary level
+        # (the shared ledger's file spans every round)
+        for p in summary["per_round"]:
+            assert "refs" in p["tail_exemplars"]
+            assert "phase_attribution" not in p["tail_exemplars"]
+        assert summary["tail_exemplars"]["phase_attribution"]["spans"] \
+            > 0
+        assert summary["tail_exemplars"]["top_bucket"]["count"] > 0
+
+    def test_tracing_off_summary_is_unchanged(self, small_setup,
+                                              engine):
+        """Knob-off acceptance: an untraced drill's summary has NO
+        tracing keys (the PR-13 line byte-for-byte) and builds no
+        ledger or spans file."""
+        cfg, variables = small_setup
+        from raft_tpu.cli.serve_bench import run_drill
+        from tests.test_scheduler import BUCKET_BATCH, SHAPES
+
+        summary = run_drill(variables, cfg, shapes=SHAPES, requests=6,
+                            submitters=2, bucket_batch=BUCKET_BATCH,
+                            gather_window_s=0.01, engine=engine)
+        assert "tail_exemplars" not in summary
+        assert "trace" not in summary
+
+
+class TestServeTraceReadback:
+    def test_exemplars_reproduce_top_bucket_membership(
+            self, tmp_path, small_setup, engine):
+        """Acceptance: the metrics snapshot's tail_exemplars refs all
+        resolve to RETAINED spans flagged tail, with total_ms in the
+        top bucket's range — serve_trace's attribution runs over the
+        same membership the histogram reports."""
+        cfg, variables = small_setup
+        from raft_tpu.cli.serve_bench import run_drill
+        from raft_tpu.cli.serve_trace import (load_spans,
+                                              phase_attribution,
+                                              tail_spans,
+                                              top_bucket_membership)
+        from tests.test_scheduler import BUCKET_BATCH, SHAPES
+
+        path = str(tmp_path / "spans.jsonl")
+        summary = run_drill(variables, cfg, shapes=SHAPES,
+                            requests=10, submitters=2,
+                            bucket_batch=BUCKET_BATCH,
+                            gather_window_s=0.01, engine=engine,
+                            trace_path=path, trace_sample=0.0)
+        blk = summary["tail_exemplars"]
+        assert blk["refs"], "drill produced no tail exemplars"
+        spans = load_spans(path)
+        retained = {s["trace_id"]: s for s in spans
+                    if s.get("span") == "request"}
+        top_gt = list(blk["refs"])
+        for ref in top_gt:
+            # retained despite sample_rate=0.0 — always-keep-tail
+            s = retained[ref["trace_id"]]
+            assert s["tail"] is True
+            # the ref's total is the histogram observation, the
+            # span's its own close clock — same request, ms apart
+            assert abs(s["total_ms"] - ref["total_ms"]) < 50.0
+        membership = top_bucket_membership(spans)
+        assert set(e["trace_id"] for e in top_gt) \
+            <= set(membership["trace_ids"])
+        attr = phase_attribution(spans)
+        assert attr["spans"] == len(tail_spans(spans))
+        shares = [p["share"] for p in attr["phases"].values()]
+        assert abs(sum(shares) - 1.0) < 0.05
+        assert blk["ledger"]["tail_kept"] >= len(top_gt)
+
+    def test_timeline_and_report_cli(self, tmp_path, capsys):
+        path = str(tmp_path / "spans.jsonl")
+        tr = TraceLedger(path)
+        sched = MicroBatchScheduler(_WarmFakeEngine(),
+                                    gather_window_s=0.0, tracer=tr)
+        sess = VideoSession(sched)
+        rng = np.random.RandomState(0)
+        futs = [sess.submit_frame(
+            rng.rand(32, 32, 3).astype(np.float32))
+            for _ in range(4)]
+        sess.drain()
+        sched.close()
+        last = [f for f in futs if f is not None][-1]
+        from raft_tpu.cli import serve_trace as st
+        spans = st.load_spans(path)
+        tl = st.timeline(spans, last.trace_id)
+        assert tl["found"] and len(tl["chain"]) == 2
+        assert tl["dispatch"]["fan_in"] >= 1
+        st.main([path, "--trace", last.trace_id])
+        out = capsys.readouterr().out
+        assert "session chain" in out and last.trace_id in out
+        st.main([path])
+        out = capsys.readouterr().out
+        assert "where did the p99 go" in out
+        assert "queue_ms" in out
+        with pytest.raises(SystemExit):
+            st.main([str(tmp_path / "missing.jsonl")])
+
+    def test_guardian_window_carries_exemplar_refs(self):
+        from raft_tpu.serving.guardian import window_stats
+        from tests.test_guardian import _blk
+
+        base = _blk(completed=10, bucket=2)
+        cur = _blk(completed=30, bucket=2)
+        base["tail_exemplars"] = {"refs": [
+            {"trace_id": "r-1", "bucket": "b", "total_ms": 5.0,
+             "bucket_idx": 2}]}
+        cur["tail_exemplars"] = {"refs": [
+            {"trace_id": "r-1", "bucket": "b", "total_ms": 5.0,
+             "bucket_idx": 2},
+            {"trace_id": "r-9", "bucket": "b", "total_ms": 9.0,
+             "bucket_idx": 2}]}
+        w = window_stats(cur, base)
+        # only exemplars NEW in the window: the decision's evidence
+        # names the trace ids behind the p99 it judged
+        assert [e["trace_id"] for e in w["exemplars"]] == ["r-9"]
+        # untraced snapshots keep the historical window schema
+        w2 = window_stats(_blk(completed=3), _blk())
+        assert "exemplars" not in w2

@@ -1,7 +1,13 @@
 """trace_summary's gviz parsing + report rollup, on a synthetic table
 shaped like xprof's hlo_stats output (the real conversion needs an
 on-accelerator XPlane capture; the parse/report layer is what must not
-break between captures)."""
+break between captures) — plus the missing-xprof surface: the lazy
+converter import must exit with an actionable install message, never
+a raw mid-function ImportError."""
+
+import sys
+
+import pytest
 
 import raft_tpu.cli.trace_summary as ts
 
@@ -42,3 +48,24 @@ def test_report_rollup_and_order(capsys):
 def test_report_empty(capsys):
     ts.report([], top=5)
     assert "no device op rows" in capsys.readouterr().out
+
+
+def test_no_xplane_files_exit(tmp_path):
+    with pytest.raises(SystemExit, match="no .*xplane"):
+        ts._load_hlo_stats(str(tmp_path))
+
+
+def test_missing_xprof_exits_with_install_hint(tmp_path, monkeypatch):
+    """This environment has no xprof — and even where one is
+    installed, the poisoned sys.modules entry forces the import
+    failure: the tool must exit with the install hint, not crash with
+    a bare ImportError after the glob already succeeded."""
+    trace_dir = tmp_path / "trace"
+    trace_dir.mkdir()
+    (trace_dir / "host.xplane.pb").write_bytes(b"\x00")
+    monkeypatch.setitem(sys.modules, "xprof", None)
+    with pytest.raises(SystemExit) as excinfo:
+        ts._load_hlo_stats(str(trace_dir))
+    msg = str(excinfo.value)
+    assert "xprof" in msg.lower()
+    assert "pip install" in msg        # actionable, not a traceback

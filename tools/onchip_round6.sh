@@ -170,6 +170,24 @@ step serve_bench_r6 1800 python -m raft_tpu.cli.serve_bench \
     --bucket-batch 4 --sessions 2 --session-frames 4 \
     --deadline-ms 30000 --gather-ms 20 --log-dir /tmp/raft_serve_r6
 
+# ---- request tracing: REAL tail exemplars + phase attribution (PR 14)
+# serve_bench_r6's traffic with the span ledger armed (full sampling —
+# this window wants every span): spans.jsonl lands beside the metrics,
+# the summary's tail_exemplars block names the top-bucket trace ids,
+# and serve_trace prints WHERE the on-chip p99 actually went (queue vs
+# assembly vs device vs fetch — the CPU drills can only fake these
+# proportions). Runs at depth 2 + u8 so the attribution covers the
+# pipelined fetch stage; feed the numbers to PROFILE.md and size the
+# production --trace-sample from the ledger's written/opened ratio.
+step serve_trace_r6 1800 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 48 --submitters 2 \
+    --bucket-batch 4 --sessions 2 --session-frames 4 \
+    --deadline-ms 30000 --gather-ms 20 \
+    --wire u8 --pipeline-depth 2 \
+    --log-dir /tmp/raft_serve_trace_r6 --trace-sample 1.0
+step serve_trace_r6_report 600 python -m raft_tpu.cli.serve_trace \
+    /tmp/raft_serve_trace_r6/spans.jsonl --top 10
+
 # ---- serving hot path: wire/pipeline A/B on the same traffic (PR 8) --
 # serve_bench_r6 above is the f32/depth-1 baseline; this rung re-runs
 # the SAME traffic with the u8 wire + depth-2 pipelined dispatch (and
