@@ -541,6 +541,12 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
     reconciles bucket-for-bucket."""
     from raft_tpu.serving.engine import RAFTEngine
 
+    if ragged and feature_cache:
+        # same boundary as run_drill's: fail before the engine below
+        # spends seconds compiling capacity classes
+        raise ValueError("ragged=True with feature_cache=True is not "
+                         "supported yet (see ROADMAP 'Ragged serving, "
+                         "next bricks' (a))")
     rng = random.Random(seed)
     if engine is None:
         if ragged:
@@ -1412,6 +1418,17 @@ def main(argv=None):
                             for s in args.capacity_classes.split(",")]
     if capacity_classes and not args.ragged:
         raise SystemExit("--capacity-classes needs --ragged")
+    if args.ragged and args.feature_cache:
+        # validated HERE, not after model init + engine compiles: the
+        # chaos path used to build (and compile) its ragged engine
+        # first and only then trip run_drill's check as a raw
+        # traceback — seconds of work for an unactionable error
+        raise SystemExit(
+            "--ragged with --feature-cache is not supported yet: the "
+            "cached signature keeps its per-shape bucket table. See "
+            "ROADMAP 'Ragged serving, next bricks' (a) — the per-row "
+            "descriptor subsuming the cached bucket matrix is the "
+            "next brick. Run the two drills separately until then.")
     if args.ragged and args.models:
         raise SystemExit("--ragged is a single-model drill knob (the "
                          "registry rungs keep the bucketed path)")

@@ -131,6 +131,21 @@ class RAFTConfig:
     # shift-mulacc rework it is a wash (54.8 vs 55.0) — the unroll had
     # been hiding upsampler latency that no longer exists. Keep 1.
     scan_unroll: int = 1
+    # encode the two frames in TWO fnet calls instead of one batch-concat
+    # call. The reference's concat trick (core/raft.py:96) is free on one
+    # device but REDISTRIBUTES under a batch-sharded mesh: concatenating
+    # two (B, H, W, 3) arrays sharded over 'data' into (2B, ...) moves
+    # every row to a new shard — XLA materializes the full concat on
+    # every device (a dynamic-update-slice + all-reduce of the images)
+    # and collective-permutes the fmap halves back, per step/dispatch
+    # (graftshard S2 caught this on the first mesh scan). fnet is
+    # instance-norm (per-sample statistics, always — see fnet_norm), so
+    # two calls are mathematically identical; only XLA CPU conv
+    # vectorization bits move with the total conv batch (the established
+    # batch-width caveat). Default False = bit-exact single-device
+    # behavior; `parallel.partitioner.mesh_model_config` turns it on
+    # whenever the 'data' axis is >1.
+    split_encode: bool = False
 
     def __post_init__(self):
         if not (isinstance(self.scan_unroll, int)

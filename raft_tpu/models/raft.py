@@ -247,11 +247,21 @@ class RAFT(nn.Module):
         image1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
         image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
 
-        # feature network over both images as one batch
-        fmaps = self.fnet(jnp.concatenate([image1, image2], axis=0),
-                          train=train, use_running_average=ura)
-        fmap1 = fmaps[:B].astype(jnp.float32)   # fp32 island for correlation
-        fmap2 = fmaps[B:].astype(jnp.float32)
+        if cfg.split_encode:
+            # two fnet calls (shared parameters): under a batch-sharded
+            # mesh the reference's concat trick below redistributes every
+            # row (see RAFTConfig.split_encode); instance norm makes the
+            # split exact per sample
+            fmap1 = self.fnet(image1, train=train,
+                              use_running_average=ura).astype(jnp.float32)
+            fmap2 = self.fnet(image2, train=train,
+                              use_running_average=ura).astype(jnp.float32)
+        else:
+            # feature network over both images as one batch
+            fmaps = self.fnet(jnp.concatenate([image1, image2], axis=0),
+                              train=train, use_running_average=ura)
+            fmap1 = fmaps[:B].astype(jnp.float32)   # fp32 island for
+            fmap2 = fmaps[B:].astype(jnp.float32)   # correlation
 
         corr_state, lookup = self._corr_setup(fmap1, fmap2)
 

@@ -4,3 +4,8 @@ from raft_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     shard_batch,
 )
+from raft_tpu.parallel.partitioner import (  # noqa: F401
+    PARTITION_RULES,
+    Partitioner,
+    mesh_model_config,
+)

@@ -77,18 +77,37 @@ def make_mesh(n_devices: Optional[int] = None, spatial: int = 1,
     return Mesh(arr, ("data", "spatial"))
 
 
+#: logical boundary values -> mesh axes per dim (None = unsharded) —
+#: the ONE spec table every sharding consumer reads: the helpers below
+#: (trainer/shard_batch), `partitioner.Partitioner` (the engine's pjit
+#: seam), and the tools/graftshard audit (which checks this exact
+#: table against the mesh a deployment builds — S4). ``frames``:
+#: (B, H, W, 3) pixels — batch over 'data', image height over
+#: 'spatial'. ``flow_init``/``flow``: the 1/8-res recurrence state and
+#: full-res flow ride the same axes. ``valid``: (B, H, W) masks.
+#: ``weights``: replicated by design — every device runs the whole net
+#: over its batch rows (FSDP-style sharded state is a ROADMAP item).
+PARTITION_RULES = {
+    "frames": ("data", "spatial", None, None),
+    "flow_init": ("data", "spatial", None, None),
+    "flow": ("data", "spatial", None, None),
+    "valid": ("data", "spatial", None),
+    "weights": (),
+}
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Images/flow (B, H, W, C): batch over 'data', height over 'spatial'."""
-    return NamedSharding(mesh, P("data", "spatial", None, None))
+    return NamedSharding(mesh, P(*PARTITION_RULES["frames"]))
 
 
 def valid_sharding(mesh: Mesh) -> NamedSharding:
     """valid mask (B, H, W)."""
-    return NamedSharding(mesh, P("data", "spatial", None))
+    return NamedSharding(mesh, P(*PARTITION_RULES["valid"]))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
+    return NamedSharding(mesh, P(*PARTITION_RULES["weights"]))
 
 
 def validate_batch_extent(batch: dict, mesh: Mesh) -> None:

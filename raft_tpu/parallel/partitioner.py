@@ -1,0 +1,141 @@
+"""Partitioner: the one pjit seam the sharded programs share.
+
+The serving fan-out (ROADMAP: replicas + sharding behind the registry)
+needs RAFTEngine buckets that can compile as SPMD programs; the train
+step already does (trainer.py's mesh). Before this module each site
+carried its own copies of the same decisions — which values shard over
+which mesh axes, what grain a geometry must divide, when a config needs
+the mesh-safe encoder path. ``Partitioner`` is the single owner of
+those DECISIONS over ``mesh.PARTITION_RULES`` — the one spec table,
+which the legacy ``mesh.py`` helpers read too (the partition-rule-
+matching idiom of the related pjit codebases, cut down to the five
+logical value kinds this model serves) — consumed by
+
+- ``RAFTEngine(mesh=...)`` — bucket sharding/validation/rounding;
+- ``training.trainer`` — mesh-safe model config + replicated rng;
+- ``tools/graftshard`` — the declared specs S4/S5 audit against.
+
+Keeping the declarations HERE is what makes the graftshard audit
+meaningful: the tier checks the same table the runtime shards with, so
+a spec drift fails the gate instead of silently replicating a value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# re-exported: the table itself lives in mesh.py (ONE copy, read by
+# the legacy helpers, this seam, and the graftshard audit alike)
+from raft_tpu.parallel.mesh import (PARTITION_RULES,  # noqa: F401
+                                    validate_spatial_extent)
+
+
+class Partitioner:
+    """Sharding decisions for one ``(data, spatial)`` mesh."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.data = mesh.shape.get("data", 1)
+        self.spatial = mesh.shape.get("spatial", 1)
+        #: kind -> NamedSharding, built once: sharding() sits on the
+        #: engine's per-dispatch path, which must not construct fresh
+        #: spec objects per request (the mesh and the rule table are
+        #: both fixed for this Partitioner's lifetime)
+        self._shardings: dict = {}
+
+    # -- specs ------------------------------------------------------------
+
+    def spec(self, kind: str) -> P:
+        return P(*PARTITION_RULES[kind])
+
+    def sharding(self, kind: str) -> NamedSharding:
+        got = self._shardings.get(kind)
+        if got is None:
+            got = NamedSharding(self.mesh, self.spec(kind))
+            self._shardings[kind] = got
+        return got
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return self.sharding("weights")
+
+    # -- geometry ---------------------------------------------------------
+
+    def grain(self) -> Tuple[int, int]:
+        """(batch grain, height grain) a bucket must divide: whole
+        examples per 'data' shard, whole ÷8 feature rows per 'spatial'
+        shard. Single source for the compile-time check and the
+        compile-on-miss rounding — the two must agree or the router's
+        own ad-hoc buckets would fail the engine's validation."""
+        return self.data, 8 * self.spatial
+
+    def validate_extent(self, image_h: int) -> None:
+        """Reject spatial shardings XLA cannot execute correctly (the
+        in-scan conv-halo fence, ``mesh.validate_spatial_extent``)."""
+        validate_spatial_extent(image_h, self.mesh)
+
+    def validate_bucket(self, shape: Tuple[int, int, int]) -> None:
+        """Raise unless a ``(B, H, W)`` bucket divides the mesh grain —
+        an uneven bucket compiles fine and only fails later at
+        device_put with an opaque uneven-sharding ValueError."""
+        b, h, _ = shape
+        bg, hg = self.grain()
+        if b % bg or h % hg:
+            raise ValueError(
+                f"bucket {shape} is not mesh-divisible: batch must "
+                f"be a multiple of data={bg} and height a "
+                f"multiple of 8*spatial={hg}")
+
+    def round_bucket(self, b: int, hp: int) -> Tuple[int, int]:
+        """Round a compile-on-miss ``(batch, padded height)`` up to the
+        mesh grain (zero-fill + output crop absorb the padding)."""
+        bg, hg = self.grain()
+        return -(-b // bg) * bg, -(-hp // hg) * hg
+
+    # -- audit surface (tools/graftshard) ---------------------------------
+
+    def declared_specs(self) -> Tuple[Tuple[str, Tuple[Optional[str], ...]],
+                                      ...]:
+        """``(value kind, axis names per dim)`` pairs — the S4 surface:
+        every named axis must exist on the mesh the program compiles
+        against."""
+        return tuple((k, tuple(v)) for k, v in PARTITION_RULES.items())
+
+    def shard_geometry(self, bucket: Tuple[int, int, int],
+                       row_bytes: int = 4,
+                       feature_dim: int = 256) -> Tuple[dict, ...]:
+        """Derived shard extents of a ``(B, H, W)`` bucket — the S5
+        surface: each entry's ``extent`` must divide its mesh ``axis``
+        or GSPMD pads the trailing shard (waste ``row_bytes`` per
+        padded element row). The feature grid (H/8) is the one a
+        boundary-even bucket can still break: H divisible by
+        ``spatial`` does not imply H/8 is. ``feature_dim`` sizes a
+        feature row's channels (the basic fnet's 256 by default — the
+        dominant per-row tensor; a padded feature row is wasted across
+        every channel, not one scalar per position)."""
+        b, h, w = bucket
+        return (
+            {"name": f"batch {b}", "extent": b, "axis": "data",
+             "row_bytes": h * w * 3 * row_bytes},
+            {"name": f"image-height {h}", "extent": h, "axis": "spatial",
+             "row_bytes": b * w * 3 * row_bytes},
+            {"name": f"feature-height {h}//8", "extent": h // 8,
+             "axis": "spatial",
+             "row_bytes": b * (w // 8) * feature_dim * row_bytes},
+        )
+
+
+def mesh_model_config(config, mesh: Mesh):
+    """The mesh-safe model config: with a >1 'data' axis the two-frame
+    batch-concat encode would REDISTRIBUTE every row per step (XLA
+    materializes the concat replicated and permutes the halves back —
+    the first real graftshard S2 finding), so turn on
+    ``split_encode`` (exact per sample: fnet is instance-norm).
+    A 1-wide data axis keeps the bit-exact single-device path."""
+    data = mesh.shape.get("data", 1)
+    if data > 1 and not getattr(config, "split_encode", False):
+        return dataclasses.replace(config, split_encode=True)
+    return config

@@ -290,6 +290,11 @@ class RAFTEngine:
         the sharded train step for resolutions/batches beyond one chip
         (SURVEY.md §5 long-context). The TRT analog has nothing like
         this; DataParallel never served (train.py:138 is training-only).
+        All sharding decisions delegate to ONE
+        ``parallel.partitioner.Partitioner`` (``self.partitioner``) —
+        the pjit seam the registry fan-out grows on, and the spec table
+        ``tools/graftshard`` audits (S1–S6) before any multi-device
+        config ships.
 
         ``exact_shapes``: never route to a SPATIALLY larger bucket —
         compile (and cache) one executable per exact ÷8-padded request
@@ -369,6 +374,19 @@ class RAFTEngine:
         """
         if wire not in ("f32", "u8"):
             raise ValueError(f"wire={wire!r}: choose 'f32' or 'u8'")
+        if ragged and feature_cache:
+            # checked FIRST: this combination must fail on ITSELF, not
+            # on whichever other knob (warm_start) happens to be
+            # missing — the caller needs the real reason, once, at the
+            # constructor, before any compile runs
+            raise ValueError(
+                "ragged=True with feature_cache=True is not supported "
+                "yet: the cached signature keeps its per-shape bucket "
+                "table. See ROADMAP 'Ragged serving, next bricks' (a) "
+                "— the per-row descriptor subsuming the cached "
+                "signature's bucket matrix is the next brick. Serve "
+                "ragged one-shot traffic and cached video from two "
+                "engines until it lands.")
         if feature_cache and not warm_start:
             raise ValueError("feature_cache=True needs warm_start=True "
                              "(the cached program carries the "
@@ -377,12 +395,6 @@ class RAFTEngine:
             raise ValueError("feature_cache is not supported under a "
                              "mesh yet — per-stream cache rows assume "
                              "single-device buckets")
-        if ragged and feature_cache:
-            raise ValueError("ragged=True with feature_cache=True is "
-                             "not supported yet — the cached signature "
-                             "keeps its per-shape bucket table (see "
-                             "ROADMAP: the descriptor subsuming it is "
-                             "the next brick)")
         if ragged and mesh is not None:
             raise ValueError("ragged=True is not supported under a "
                              "mesh yet — capacity classes assume "
@@ -416,16 +428,23 @@ class RAFTEngine:
         #: threads can't race a compile-on-miss insert
         self._lock = threading.RLock()
         if mesh is not None:
-            from raft_tpu.parallel.mesh import (batch_sharding, replicated,
-                                                validate_spatial_extent)
+            from raft_tpu.parallel.partitioner import (Partitioner,
+                                                       mesh_model_config)
 
-            self._in_shard = batch_sharding(mesh)
-            self._rep = replicated(mesh)
-            self._validate_extent = validate_spatial_extent
-            self.variables = jax.device_put(variables, self._rep)
+            #: the pjit seam: all sharding decisions (which value rides
+            #: which mesh axis, bucket grains, extent fences) live in
+            #: ONE Partitioner — the same table tools/graftshard audits
+            self.partitioner = Partitioner(mesh)
+            self.variables = jax.device_put(variables,
+                                            self.partitioner.replicated)
+            # mesh-safe encoder path: the batch-concat encode would
+            # redistribute every row per dispatch (see
+            # RAFTConfig.split_encode); weights are identical either way
+            model = RAFT(mesh_model_config(config, mesh))
         else:
+            self.partitioner = None
             self.variables = jax.device_put(variables)
-        model = RAFT(config)
+            model = RAFT(config)
 
         if warm_start:
             def serve(variables, image1, image2, flow_init):
@@ -605,7 +624,7 @@ class RAFTEngine:
         would brick every precompiled bucket with an opaque call-time
         error if it slipped through here."""
         self._check_weights(variables)
-        staged = (jax.device_put(variables, self._rep)
+        staged = (jax.device_put(variables, self.partitioner.replicated)
                   if self.mesh is not None
                   else jax.device_put(variables))
         # the swap itself is a single reference assignment under the
@@ -618,15 +637,6 @@ class RAFTEngine:
             self.weights_version += 1
 
     # -- shape routing ------------------------------------------------------
-
-    def _mesh_grain(self) -> Tuple[int, int]:
-        """(batch grain, height grain) a bucket must divide under a mesh.
-        Single source for both the compile-time check and the
-        compile-on-miss rounding — the two must agree or the router's own
-        ad-hoc buckets would fail the engine's validation."""
-        data = self.mesh.shape.get("data", 1)
-        spatial = self.mesh.shape.get("spatial", 1)
-        return data, 8 * spatial
 
     def _get_executable(self, shape: Tuple[int, int, int], variables=None,
                         cached: bool = False, ragged: bool = False):
@@ -648,18 +658,14 @@ class RAFTEngine:
             return exe
         b, h, w = shape
         if self.mesh is not None:
-            self._validate_extent(h, self.mesh)
+            self.partitioner.validate_extent(h)
             # compile-on-miss buckets are pre-rounded in infer_batch,
             # but user-supplied envelope buckets reach here unrounded;
-            # an uneven bucket compiles fine and only fails later at
-            # device_put with an opaque uneven-sharding ValueError
-            bg, hg = self._mesh_grain()
-            if b % bg or h % hg:
-                raise ValueError(
-                    f"bucket {shape} is not mesh-divisible: batch must "
-                    f"be a multiple of data={bg} and height a "
-                    f"multiple of 8*spatial={hg}")
-            shard = self._in_shard
+            # the partitioner rejects uneven ones at compile time with
+            # a readable message instead of the later opaque
+            # uneven-sharding device_put error
+            self.partitioner.validate_bucket(shape)
+            shard = self.partitioner.sharding("frames")
         else:
             shard = None
         # wire="u8" buckets take uint8 frames; the normalize's
@@ -694,9 +700,11 @@ class RAFTEngine:
             if self.warm_start:
                 # flow_init rides at 1/8 res; h % (8*spatial) == 0
                 # under a mesh makes h//8 divide the spatial axis, so
-                # the same batch+spatial sharding applies
+                # the same batch+spatial rule applies
                 args.append(jax.ShapeDtypeStruct(
-                    (b, h // 8, w // 8, 2), jnp.float32, sharding=shard))
+                    (b, h // 8, w // 8, 2), jnp.float32,
+                    sharding=(self.partitioner.sharding("flow_init")
+                              if self.mesh is not None else None)))
             fn = self._fn
         # compile OUTSIDE the lock: minutes on real hardware, and the
         # lock must stay cheap (weight swaps and already-compiled
@@ -762,9 +770,7 @@ class RAFTEngine:
                 # whole examples and whole feature rows (the bucket's
                 # zero-fill + output crop absorbs the padding either
                 # way)
-                bg, hg = self._mesh_grain()
-                bb = -(-b // bg) * bg
-                bh = -(-hp // hg) * hg
+                bb, bh = self.partitioner.round_bucket(b, hp)
             bucket = (bb, bh, wp)
         return bucket
 
@@ -1011,7 +1017,11 @@ class RAFTEngine:
                 h2d += finit.nbytes
             args.append(finit)
         if self.mesh is not None:
-            args = [jax.device_put(a, self._in_shard) for a in args]
+            part = self.partitioner
+            kinds = ["frames", "frames"] + (["flow_init"]
+                                            if self.warm_start else [])
+            args = [jax.device_put(a, part.sharding(k))
+                    for a, k in zip(args, kinds)]
         else:
             args = [jnp.asarray(a) for a in args]
         out = exe(variables, *args)

@@ -31,6 +31,7 @@ import numpy as np
 
 from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.parallel.mesh import make_mesh, replicated, shard_batch
+from raft_tpu.parallel.partitioner import mesh_model_config
 from raft_tpu.testing import faults
 from raft_tpu.training import checkpoint as ckpt_lib
 from raft_tpu.training.logger import Logger
@@ -103,6 +104,10 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
             on_bad_sample=train_cfg.on_bad_sample, stall_s=train_cfg.stall_s)
 
     mesh = make_mesh()
+    # mesh-safe encoder path on a >1 'data' axis (weights identical; the
+    # batch-concat encode would redistribute every row per step — see
+    # RAFTConfig.split_encode / graftshard S2)
+    model_cfg = mesh_model_config(model_cfg, mesh)
     step_fn = jax.jit(make_train_step(model_cfg, train_cfg),
                       donate_argnums=(0,))
     schedule = onecycle_linear_schedule(train_cfg.lr, train_cfg.num_steps + 100)
@@ -125,6 +130,10 @@ def train(model_cfg: RAFTConfig, train_cfg: TrainConfig,
     try:
         with mesh:
             state = jax.device_put(state, replicated(mesh))
+            # the base key is a boundary value of every step too:
+            # declare it replicated instead of leaving XLA to resolve
+            # an unconstrained host array (graftshard S4 discipline)
+            rng = jax.device_put(rng, replicated(mesh))
             total_steps = int(state.step)
             keep_training = total_steps < train_cfg.num_steps
             prof = train_cfg.profile_steps

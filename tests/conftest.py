@@ -49,3 +49,30 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)
+
+
+def mesh_subprocess_env(local_devices: int = 1, extra_env=None) -> dict:
+    """Environment for spawning a worker process with its OWN forced
+    CPU device count.
+
+    This test process runs on the conftest-forced 8-virtual-device
+    mesh (the XLA_FLAGS above); a subprocess inherits that flag and
+    with it a device count the test didn't choose. Strip it, then
+    re-force exactly ``local_devices`` (>1 only — a 1-device worker
+    needs no flag). One definition for every subprocess-mesh test —
+    the 2-process ``jax.distributed`` suite and the graftshard gate
+    both spawn through here, so the recipe can't drift between them.
+    """
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    if local_devices > 1:
+        env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                            f"{local_devices}")
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
+@pytest.fixture
+def mesh_worker_env():
+    """The subprocess-mesh env builder, as a fixture."""
+    return mesh_subprocess_env

@@ -1,0 +1,41 @@
+"""The negative fixture: a well-partitioned program every rule stays
+silent on — specs declared and naming real axes, extents dividing
+their axes, donation honored (same sharding in and out), reductions
+OUTSIDE the loop, no callbacks, no in-program placement."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tools.graftshard import ShardTarget
+
+
+def _build():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    sharded = NamedSharding(mesh, P("data"))
+
+    def f(state, x):
+        def body(c, _):
+            # per-shard work only — no cross-device op in the loop
+            return c * 1.01 + x * 0.5, ()
+        c, _ = jax.lax.scan(body, x, None, length=3)
+        # the reduction happens ONCE, outside the loop
+        return state + c, c.sum()
+
+    st = jax.ShapeDtypeStruct((8, 16), jnp.float32, sharding=sharded)
+    xs = jax.ShapeDtypeStruct((8, 16), jnp.float32, sharding=sharded)
+    return f, (st, xs), mesh
+
+
+TARGETS = [
+    ShardTarget(
+        name="clean_fixture",
+        build=_build,
+        donate_argnums=(0,),
+        declared_specs=(("rows", ("data", None)),),
+        shard_geometry=(
+            {"name": "rows 8", "extent": 8, "axis": "data",
+             "row_bytes": 64},
+        )),
+]

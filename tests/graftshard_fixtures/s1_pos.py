@@ -1,0 +1,31 @@
+"""S1 planted violation: a collective inside the scan body.
+
+A per-iteration mean over the batch-sharded input forces GSPMD to put
+an all-reduce INSIDE the compiled while body — the comm-in-loop hazard
+(at iters=20 this is 20 reductions per call)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tools.graftshard import ShardTarget
+
+
+def _build():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+
+    def step(x):
+        def body(c, _):
+            # mean over the sharded dim, per iteration -> all-reduce
+            # in the loop body after partitioning
+            return c + jnp.mean(x * c), ()
+        c, _ = jax.lax.scan(body, jnp.float32(1.0), None, length=5)
+        return c
+
+    xs = jax.ShapeDtypeStruct((8, 16), jnp.float32,
+                              sharding=NamedSharding(mesh, P("data")))
+    return step, (xs,), mesh
+
+
+TARGETS = [ShardTarget(name="s1_fixture", build=_build)]

@@ -1,0 +1,33 @@
+"""S2 planted violations: large values resolved to full replication.
+
+Two of the rule's three surfaces in one tiny program: a 256 KiB
+boundary arg declared replicated though the 'data' axis divides it,
+and a with_sharding_constraint pinning a big intermediate to
+``P()``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tools.graftshard import ShardTarget
+
+
+def _build():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    rep = NamedSharding(mesh, P())
+    sharded = NamedSharding(mesh, P("data"))
+
+    def f(big_rep, x):
+        y = x * 2.0
+        # a big intermediate explicitly constrained to replication
+        z = jax.lax.with_sharding_constraint(
+            jnp.broadcast_to(y.sum(), (64, 1024)), rep)
+        return (big_rep * 1.5).sum() + z.sum()
+
+    big = jax.ShapeDtypeStruct((64, 1024), jnp.float32, sharding=rep)
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32, sharding=sharded)
+    return f, (big, x), mesh
+
+
+TARGETS = [ShardTarget(name="s2_fixture", build=_build)]

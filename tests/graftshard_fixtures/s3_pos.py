@@ -1,0 +1,26 @@
+"""S3 planted violation: ``jax.device_put`` traced INSIDE the mesh
+program — in-program placement is a hidden reshard; it belongs in the
+dispatch layer (or as a declarative with_sharding_constraint)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tools.graftshard import ShardTarget
+
+
+def _build():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    rep = NamedSharding(mesh, P())
+    sharded = NamedSharding(mesh, P("data"))
+
+    def f(x):
+        y = jax.device_put(x, rep)      # traced into the program
+        return y.sum()
+
+    xs = jax.ShapeDtypeStruct((8, 16), jnp.float32, sharding=sharded)
+    return f, (xs,), mesh
+
+
+TARGETS = [ShardTarget(name="s3_fixture", build=_build)]

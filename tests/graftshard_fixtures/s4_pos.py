@@ -1,0 +1,45 @@
+"""S4 planted violations, both halves of the rule:
+
+- a DECLARED spec naming a mesh axis ('model') the mesh doesn't have
+  — the declaration layer drifted from the deployment mesh;
+- a boundary arg entering the program with NO sharding at all — XLA
+  silently replicates it (the with_sharding_constraint discipline)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tools.graftshard import ShardTarget
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:4]), ("data",))
+
+
+def _build_decl():
+    return _mesh()
+
+
+def _build_unconstrained():
+    mesh = _mesh()
+    sharded = NamedSharding(mesh, P("data"))
+
+    def f(a, b):
+        return a.sum() + b.sum()
+
+    a = jax.ShapeDtypeStruct((8, 16), jnp.float32, sharding=sharded)
+    b = jax.ShapeDtypeStruct((16, 128), jnp.float32)   # no sharding
+    return f, (a, b), mesh
+
+
+TARGETS = [
+    ShardTarget(
+        name="s4_decl_fixture",
+        kind="decl",
+        build=_build_decl,
+        declared_specs=(("activations", ("data", "model")),)),
+    ShardTarget(
+        name="s4_unconstrained_fixture",
+        build=_build_unconstrained),
+]

@@ -1,0 +1,26 @@
+"""S5 planted violation: a declared shard geometry whose extent does
+not divide its mesh axis — GSPMD pads the trailing shard and every
+device computes the dead rows (the ragged-tail lesson at the shard
+level, reported as waste bytes)."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tools.graftshard import ShardTarget
+
+
+def _build():
+    return Mesh(np.array(jax.devices()[:4]), ("data",))
+
+
+TARGETS = [
+    ShardTarget(
+        name="s5_fixture",
+        kind="decl",
+        build=_build,
+        shard_geometry=(
+            {"name": "feature-height 6", "extent": 6, "axis": "data",
+             "row_bytes": 4096},
+        )),
+]

@@ -1,0 +1,35 @@
+"""S6 planted violation: a donation dropped by resharding.
+
+``state`` is donated and sharded over 'data'; the matching output is
+constrained replicated, so the value physically moves between devices
+and XLA silently DEGRADES the donation (``buffer_donor`` instead of an
+``input_output_alias`` entry) — the program pays an input-sized copy
+every call. Shapes are kept tiny so this plants ONLY the S6 hazard
+(the resharded value stays under the S2 threshold)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tools.graftshard import ShardTarget
+
+
+def _build():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    sharded1 = NamedSharding(mesh, P("data"))
+    sharded2 = NamedSharding(mesh, P(None, "data"))
+    rep = NamedSharding(mesh, P())
+
+    def f(state, x):
+        out = state + x.sum(0)
+        # resharding the donated input's successor kills the alias
+        return jax.lax.with_sharding_constraint(out, rep), x * 2.0
+
+    st = jax.ShapeDtypeStruct((16,), jnp.float32, sharding=sharded1)
+    xs = jax.ShapeDtypeStruct((8, 16), jnp.float32, sharding=sharded2)
+    return f, (st, xs), mesh
+
+
+TARGETS = [ShardTarget(name="s6_fixture", build=_build,
+                       donate_argnums=(0,))]

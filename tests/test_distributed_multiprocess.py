@@ -30,6 +30,8 @@ import pytest
 
 import jax
 
+from tests.conftest import mesh_subprocess_env
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -58,7 +60,7 @@ def _multiprocess_backend_probe():
     ``jax.distributed`` collectives on the configured backend? Cached
     for the session — one ~10s probe gates the whole module."""
     port = _free_port()
-    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env = mesh_subprocess_env(local_devices=1)
     procs = [subprocess.Popen(
         [sys.executable, "-c", _PROBE_SRC, str(i), str(port)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -115,10 +117,7 @@ def _single_process_loss(n_devices: int = 2, spatial: int = 1) -> float:
 def _run_two_process(spatial: int, local_devices: int) -> list:
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "dist_worker.py")
-    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    if local_devices > 1:
-        env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
-                            f"{local_devices}")
+    env = mesh_subprocess_env(local_devices=local_devices)
     cmd_tail = [str(port)] + ([str(spatial)] if spatial > 1 else [])
     procs = [subprocess.Popen([sys.executable, worker, str(i)] + cmd_tail,
                               stdout=subprocess.PIPE,

@@ -312,6 +312,28 @@ class TestRaggedEngine:
                 [(np.zeros((32, 32, 3)), np.zeros((32, 32, 3)))],
                 flow_inits=[np.zeros((5, 5, 2), np.float32)])
 
+    def test_ragged_feature_cache_rejected_at_the_boundary(self,
+                                                           small_setup):
+        """The unsupported combination must fail on ITSELF — an
+        actionable not-yet-supported error naming the ROADMAP brick —
+        at every boundary, BEFORE any compile spends seconds:
+        constructor (whatever warm_start says), chaos-drill library
+        call (which used to compile its ragged engine first and only
+        then trip run_drill's check as a raw traceback), and the CLI
+        parse."""
+        cfg, variables = small_setup
+        for warm in (False, True):
+            with pytest.raises(ValueError, match="ROADMAP"):
+                RAFTEngine(variables, cfg, ragged=True,
+                           feature_cache=True, warm_start=warm)
+        from raft_tpu.cli.serve_bench import run_chaos_drill
+        with pytest.raises(ValueError, match="ROADMAP"):
+            run_chaos_drill(variables, cfg, shapes=[(32, 32)],
+                            ragged=True, feature_cache=True)
+        from raft_tpu.cli.serve_bench import main as serve_bench_main
+        with pytest.raises(SystemExit, match="ROADMAP"):
+            serve_bench_main(["--ragged", "--feature-cache"])
+
 
 class TestRaggedScheduler:
     def test_cross_shape_coalescing_one_executable(self, ragged_engine):

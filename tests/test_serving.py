@@ -428,6 +428,53 @@ class TestMeshServing:
         with pytest.raises(ValueError, match="warm_start"):
             cold.infer_batch(img1, img2, return_low=True)
 
+    def test_sharded_engine_bitwise_vs_bucket_batch1_oracle(self,
+                                                            small_setup):
+        """The graftshard-PR parity pin, same geometry discipline as
+        the PR-12/PR-13 pins: the pjit-sharded engine with batch
+        sharded over a data-only (1×N-style) CPU mesh, at bucket batch
+        == the axis size (one request per device), is BITWISE the
+        single-device bucket path at bucket-batch-1 integer inputs —
+        SPMD partitioning itself adds zero numeric noise; each shard
+        runs exactly the per-device program.
+
+        The single-device oracle compiles ``split_encode=True``: that
+        IS the mesh program's per-device form (mesh_model_config turns
+        it on for data>1). Against the DEFAULT concat-encode path the
+        fnet convs run at total batch 2 instead of 1, which moves
+        XLA-CPU conv bits (the established batch-width caveat) — that
+        leg is pinned approximately, not bitwise."""
+        import dataclasses
+
+        from raft_tpu.parallel.mesh import make_mesh
+
+        cfg, variables = small_setup
+        h = w = 32
+        rng = np.random.RandomState(7)
+        i1 = rng.randint(0, 256, (2, h, w, 3)).astype(np.float32)
+        i2 = rng.randint(0, 256, (2, h, w, 3)).astype(np.float32)
+
+        mesh = make_mesh(2, spatial=1)
+        eng = RAFTEngine(variables, cfg, iters=2,
+                         envelope=[(2, h, w)], precompile=True,
+                         mesh=mesh)
+        flows = eng.infer_batch(i1, i2)
+
+        oracle = RAFTEngine(variables,
+                            dataclasses.replace(cfg, split_encode=True),
+                            iters=2, exact_shapes=True)
+        for r in range(2):
+            ref = oracle.infer_batch(i1[r:r + 1], i2[r:r + 1])[0]
+            assert np.array_equal(flows[r], ref), \
+                f"sharded row {r} is not bitwise the bucket-batch-1 " \
+                f"oracle (max abs {np.abs(flows[r] - ref).max()})"
+        # the concat-encode leg: same math, conv-batch-width bit noise
+        # only (a partitioning bug is orders of magnitude larger)
+        concat = RAFTEngine(variables, cfg, iters=2, exact_shapes=True)
+        for r in range(2):
+            ref = concat.infer_batch(i1[r:r + 1], i2[r:r + 1])[0]
+            np.testing.assert_allclose(flows[r], ref, atol=1e-2)
+
     def test_sharded_engine_rejects_thin_spatial_shards(self, small_setup,
                                                        rng):
         from raft_tpu.parallel.mesh import make_mesh

@@ -1,0 +1,35 @@
+"""graftshard: the sharding & collectives static-analysis tier.
+
+Fourth tier of the gate family — graftlint reads source, graftaudit
+reads single-device compiled artifacts, graftthread reads
+thread-safety declarations, graftshard reads PARTITIONED programs: the
+real mesh programs (the data-parallel train step, the pjit-sharded
+serve trace) compiled on a forced multi-device CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=4`` — no TPU
+needed), audited at jaxpr + StableHLO + optimized-HLO level against
+rules S1–S6, each a concrete sharding bug class:
+
+- S1 ``comm-in-loop``: collectives inside the scan/while body —
+  per-iteration communication;
+- S2 ``replicated-large-value``: big values resolved to full
+  replication a mesh axis could shard;
+- S3 ``host-transfer-in-mesh-program``: callbacks / in-program
+  ``device_put`` inside the compiled hot path;
+- S4 ``spec-inconsistent``: specs naming absent axes; unconstrained
+  boundary values XLA silently replicates;
+- S5 ``uneven-shard-padding``: extents that don't divide their mesh
+  axis (waste bytes reported);
+- S6 ``donation-dropped-by-resharding``: declared donations whose
+  ``input_output_alias`` vanished under partitioning.
+
+Same surface as the siblings: ``python -m tools.graftshard --json``,
+shrink-only (and EMPTY) ``baseline.json``, per-finding ``Waiver`` with
+required justification, lintcache-backed warm repeats. The meta-gate
+``python -m tools.graft --json`` runs all four tiers.
+"""
+
+from .core import (apply_baseline, audit_targets,  # noqa: F401
+                   load_baseline, load_fixture_targets, main,
+                   write_baseline)
+from .finding import ShardFinding  # noqa: F401
+from .spec import Artifacts, ShardTarget, Waiver  # noqa: F401

@@ -1,0 +1,200 @@
+"""Build shard-audit artifacts: trace, lower, and compile on a forced
+multi-device CPU mesh.
+
+Sharding structure — which values replicate, where GSPMD inserts
+collectives, whether a donation survives resharding — is decided at
+trace/lower/partition time, not by the execution platform, so a CPU
+host forced to ``--xla_force_host_platform_device_count=4`` exercises
+the same SPMD partitioner a TPU pod runs (the byte THRESHOLDS are the
+one platform-sensitive knob; ``shard_audit_r6`` re-anchors them from
+real sharded TPU HLO).
+"""
+
+from __future__ import annotations
+
+import time
+
+from .spec import ArgInfo, Artifacts, ShardTarget
+
+#: devices the audit mesh needs; the driver forces the CPU host to at
+#: least this many when it owns the interpreter
+MESH_DEVICES = 4
+
+
+
+def prepare_env(min_devices: int = MESH_DEVICES) -> None:
+    """Env-only half of :func:`ensure_mesh_cpu`: set the CPU backend +
+    device-count flags if jax is not yet imported, WITHOUT importing
+    jax. The driver calls this before loading fixture modules (which,
+    like the sibling tiers' fixtures, import jax at module scope)."""
+    import os
+    import sys
+
+    if "jax" not in sys.modules:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{min_devices}").strip()
+
+
+def ensure_mesh_cpu(min_devices: int = MESH_DEVICES):
+    """Force the CPU backend with >= ``min_devices`` virtual devices.
+
+    Same discipline as graftaudit's ``ensure_cpu`` (the image's
+    sitecustomize registers the 'axon' remote-TPU plugin everywhere —
+    an audit must never dial the tunnel), plus the host-platform
+    device-count flag, which only works BEFORE jax initializes. Inside
+    pytest the conftest already forced 8 devices; a bare
+    ``python -m tools.graftshard`` sets its own flag here. An
+    interpreter that already initialized jax with too few devices
+    cannot grow them — that is a usage error, reported actionably.
+    """
+    prepare_env(min_devices)
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    n = len(jax.devices())
+    if n < min_devices:
+        raise RuntimeError(
+            f"graftshard needs a {min_devices}-device mesh but this "
+            f"interpreter already initialized jax with {n} device(s) — "
+            "run `python -m tools.graftshard` in a fresh process, or "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{min_devices} before anything imports jax")
+    return jax
+
+
+def _entry_arg_chunks(lowered_text: str):
+    """``(index, chunk text)`` per entry parameter of the lowered
+    module's ``@main`` signature. Split on ``%arg`` instead of regexing
+    attribute dicts: a mesh program's attrs NEST braces
+    (``mhlo.sharding = "{devices=[4]<=[4]}"``), which brace-matching
+    regexes silently fail on — graftaudit's single-device ``_ARG_RE``
+    is exactly such a regex and must not be reused here."""
+    try:
+        sig = lowered_text[lowered_text.index("@main("):]
+        sig = sig[:sig.index(") -> ")]
+    except ValueError:
+        return
+    for chunk in sig.split("%arg")[1:]:
+        ix = chunk.split(":", 1)[0]
+        if ix.isdigit():
+            yield int(ix), chunk
+
+
+def annotated_args(lowered_text: str) -> set:
+    """Flat arg indices whose LOWERED entry signature carries an
+    explicit ``mhlo.sharding`` attribute. XLA resolves the rest to
+    replicated without a word — the S4 'unconstrained boundary'
+    surface."""
+    return {ix for ix, chunk in _entry_arg_chunks(lowered_text)
+            if "mhlo.sharding" in chunk}
+
+
+def declared_donations(lowered_text: str) -> list:
+    """Flat arg indices the lowered mesh module marks donatable
+    (``tf.aliasing_output`` when jax matched an output itself,
+    ``jax.buffer_donor`` when it deferred to XLA) — the S6 input set."""
+    return sorted(ix for ix, chunk in _entry_arg_chunks(lowered_text)
+                  if "tf.aliasing_output" in chunk
+                  or "jax.buffer_donor" in chunk)
+
+
+def _spec_tuple(sharding):
+    """NamedSharding -> per-dim spec tuple, or None when the sharding
+    carries no spec (GSPMD/other backends)."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    return tuple(spec)
+
+
+def _info(index, path, aval, sharding, annotated=True) -> ArgInfo:
+    import numpy as np
+
+    nbytes = int(np.prod(aval.shape)) * aval.dtype.itemsize \
+        if aval.shape else aval.dtype.itemsize
+    # UNKNOWN sharding (compiled=False target, or a jax version whose
+    # input_shardings read failed) must not read as replicated — S2
+    # would then report false replicated-large-value findings for
+    # every properly-sharded boundary value
+    replicated = bool(getattr(sharding, "is_fully_replicated", False)) \
+        if sharding is not None else False
+    return ArgInfo(index=index, path=path, shape=tuple(aval.shape),
+                   dtype=str(aval.dtype), nbytes=nbytes,
+                   spec=_spec_tuple(sharding) if sharding is not None
+                   else None,
+                   replicated=replicated, annotated=annotated)
+
+
+def build_artifacts(target: ShardTarget) -> Artifacts:
+    """Trace/lower/compile one target on its mesh and bundle what the
+    rules need."""
+    jax = ensure_mesh_cpu()
+    t0 = time.perf_counter()
+    art = Artifacts()
+    if target.kind == "decl":
+        mesh = target.build()
+        art.mesh_axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        art.seconds = time.perf_counter() - t0
+        return art
+    if target.kind != "trace":
+        raise ValueError(f"target {target.name}: unknown kind "
+                         f"{target.kind!r} (trace|decl)")
+    fn, args, mesh = target.build()
+    art.mesh_axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tuple(args))
+    paths = [jax.tree_util.keystr(p) for p, _ in flat]
+
+    art.jaxpr = jax.make_jaxpr(fn)(*args)
+    jitted = jax.jit(fn, donate_argnums=target.donate_argnums)
+    lowered = jitted.lower(*args)
+    art.lowered_text = lowered.as_text()
+    ann = annotated_args(art.lowered_text)
+
+    in_avals = list(art.jaxpr.in_avals)
+    in_shardings = None
+    out_shardings = None
+    out_paths = None
+    if target.compiled:
+        compiled = lowered.compile()
+        art.hlo_text = compiled.as_text()
+        try:
+            args_sh, _kwargs_sh = compiled.input_shardings
+            in_shardings = jax.tree_util.tree_leaves(
+                args_sh, is_leaf=lambda x: x is None)
+            out_flat, _ = jax.tree_util.tree_flatten_with_path(
+                compiled.output_shardings,
+                is_leaf=lambda x: x is None)
+            out_paths = [jax.tree_util.keystr(p) for p, _ in out_flat]
+            out_shardings = [s for _, s in out_flat]
+        except Exception:
+            pass
+
+    for i, aval in enumerate(in_avals):
+        sh = (in_shardings[i] if in_shardings is not None
+              and i < len(in_shardings) else None)
+        art.in_info.append(_info(
+            i, paths[i] if i < len(paths) else f"arg{i}", aval, sh,
+            annotated=(i in ann)))
+    for i, aval in enumerate(art.jaxpr.out_avals):
+        sh = (out_shardings[i] if out_shardings is not None
+              and i < len(out_shardings) else None)
+        # output paths come from the output tree (so a waiver can
+        # scope to e.g. the returned train state, " [0][", without
+        # swallowing every output); outputs have no annotation story —
+        # propagation to outputs is allowed by design
+        path = (out_paths[i] if out_paths is not None
+                and i < len(out_paths) else f"out[{i}]")
+        art.out_info.append(_info(i, path, aval, sh))
+    art.seconds = time.perf_counter() - t0
+    return art

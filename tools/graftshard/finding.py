@@ -1,0 +1,33 @@
+"""The one record type every graftshard rule emits.
+
+Identical shape to graftaudit's (``tools/graftaudit/finding.py``): a
+sharding finding anchors to a *target* (a partitioned program compiled
+on the forced multi-device CPU mesh) plus a stable ``detail`` string
+(op_name, flat-arg path, geometry name) — the detail IS the baseline
+identity, since compiled artifacts have no line numbers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class ShardFinding:
+    target: str    # shard target name, e.g. "train_step_dp"
+    rule: str      # "S1".."S6"
+    name: str      # kebab-case rule name, e.g. "comm-in-loop"
+    detail: str    # stable identity inside the artifact (op_name, arg
+                   # path, geometry name)
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.target}: {self.rule}[{self.name}] "
+                f"{self.message}")
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: details derive from op_names, arg paths
+        and declared geometry, which survive recompiles of the same
+        program."""
+        return (self.target, self.rule, self.detail)

@@ -1,0 +1,16 @@
+"""graftshard rules S1–S6, one module per sharding bug class.
+
+Every module exports ``RULE`` (the id), ``NAME`` (kebab-case), and
+``check(target, art) -> List[ShardFinding]``. Waivers are applied by
+the driver, not here.
+"""
+
+from . import comm_in_loop            # noqa: F401  (S1)
+from . import replication             # noqa: F401  (S2)
+from . import host_transfer           # noqa: F401  (S3)
+from . import spec_consistency        # noqa: F401  (S4)
+from . import uneven_shard            # noqa: F401  (S5)
+from . import donation_reshard        # noqa: F401  (S6)
+
+ALL_RULES = [comm_in_loop, replication, host_transfer,
+             spec_consistency, uneven_shard, donation_reshard]

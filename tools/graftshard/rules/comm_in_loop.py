@@ -1,0 +1,89 @@
+"""S1: collectives inside a loop body — per-iteration communication.
+
+The refinement GRU runs as a ``lax.scan``; a collective GSPMD places
+INSIDE the compiled ``while`` body executes once per iteration — at
+``iters=20`` a single stray all-gather is twenty all-gathers per
+request, and the latency multiplies exactly where the serving stack
+can least afford it (arXiv 2604.15464's lesson: per-iteration comm
+and padding discipline decide TPU serving throughput). Ground truth
+is the optimized (SPMD-partitioned) HLO: every collective whose
+computation is reachable from a ``while`` op's ``body=``/``condition=``
+region (transitively through called sub-computations) fires here.
+
+The jaxpr tier catches the EXPLICIT form too: ``psum``-family
+primitives traced into a scan/while body (a shard_map'd reduction
+inside the loop) — visible before XLA ever runs.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import ShardFinding
+from ..spec import Artifacts, ShardTarget
+
+RULE = "S1"
+NAME = "comm-in-loop"
+
+#: explicit collective primitives at the jaxpr tier
+_COLLECTIVE_PRIMS = ("psum", "pmax", "pmin", "pmean", "all_gather",
+                     "all_to_all", "ppermute", "pshuffle",
+                     "reduce_scatter")
+
+#: jaxpr loop primitives whose body params hold per-iteration code
+_LOOP_PRIMS = ("scan", "while")
+
+
+def _jaxpr_findings(target: ShardTarget, art: Artifacts,
+                    out: List[ShardFinding], seen: set) -> None:
+    def walk_loops(jaxpr, in_loop: bool):
+        for eqn in jaxpr.eqns:
+            pname = eqn.primitive.name
+            if in_loop and any(pname == p or pname.startswith(p + "_")
+                               for p in _COLLECTIVE_PRIMS):
+                detail = f"{pname} @ {eqn.source_info.name_stack}"
+                if detail not in seen:
+                    seen.add(detail)
+                    out.append(ShardFinding(
+                        target.name, RULE, NAME, detail,
+                        f"'{pname}' traced inside a scan/while body at "
+                        f"{eqn.source_info.name_stack} — this "
+                        "collective runs once per iteration"))
+            inner_loop = in_loop or pname in _LOOP_PRIMS
+            for v in eqn.params.values():
+                for j in (v if isinstance(v, (list, tuple)) else [v]):
+                    inner = None
+                    if hasattr(j, "eqns"):
+                        inner = j
+                    elif hasattr(j, "jaxpr") and hasattr(j.jaxpr, "eqns"):
+                        inner = j.jaxpr
+                    if inner is not None:
+                        walk_loops(inner, inner_loop)
+
+    walk_loops(art.jaxpr.jaxpr, False)
+
+
+def check(target: ShardTarget, art: Artifacts) -> List[ShardFinding]:
+    out: List[ShardFinding] = []
+    seen: set = set()
+    if art.jaxpr is not None:
+        _jaxpr_findings(target, art, out, seen)
+    if art.hlo_text:
+        from tools import hlo_lib
+
+        bodies = hlo_lib.while_body_computations(art.hlo_text)
+        for rec in hlo_lib.find_collectives(art.hlo_text, within=bodies):
+            detail = (f"{rec['opcode']} {rec['shape']} @ "
+                      f"{rec['op_name'] or '(no op_name)'}")
+            if detail in seen:
+                continue
+            seen.add(detail)
+            out.append(ShardFinding(
+                target.name, RULE, NAME, detail,
+                f"'{rec['opcode']}' of {rec['shape']} "
+                f"({rec['bytes']:,} bytes) inside loop body "
+                f"'{rec['comp']}' at "
+                f"{rec['op_name'] or '(no op_name)'} — executes once "
+                "per scan iteration; hoist it out of the loop or "
+                "reshard outside the scan"))
+    return out

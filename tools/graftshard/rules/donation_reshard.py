@@ -1,0 +1,84 @@
+"""S6: donation dropped under resharding — the H4 analog that only
+exists on a mesh.
+
+graftaudit H4 verifies XLA honors single-device donations; on a mesh a
+new way to lose one appears: when a donated input's sharding differs
+from its matching output's, XLA cannot alias the buffers (the value
+physically moves between devices) and silently DEGRADES the donation —
+the optimized module carries the arg as a mere ``buffer_donor`` (or
+nothing) instead of an ``input_output_alias`` entry, and the program
+pays an input-sized copy every call. The serve seam's whole zero-copy
+story (donated flow_init → flow_low, three donated cache rows) rides
+on these aliases surviving partitioning; this rule is the proof.
+
+Detection is graftaudit's, re-grounded: flat args the LOWERED module
+marks donatable (``tf.aliasing_output``/``jax.buffer_donor``) must
+appear in the optimized module's ``input_output_alias`` map. The mesh
+twist is the attribution — on a miss, the rule compares the input's
+resolved sharding against same-shaped outputs' and names the spec
+mismatch that killed the alias.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import ShardFinding
+from ..spec import Artifacts, ShardTarget
+
+RULE = "S6"
+NAME = "donation-dropped-by-resharding"
+
+
+def _cause(inf, art: Artifacts) -> str:
+    """Why the alias died: the same-shaped output whose sharding
+    differs, if one exists."""
+    for o in art.out_info:
+        if o.shape == inf.shape and o.dtype == inf.dtype:
+            if o.spec != inf.spec:
+                return (f"input sharded {inf.spec} but its same-shaped "
+                        f"output {o.path} resolved {o.spec} — the "
+                        "reshard copy breaks the alias; constrain the "
+                        "output to the input's spec (or stop donating)")
+            return (f"a same-sharded output ({o.path}) exists — XLA "
+                    "still declined; check layout/tuple-position "
+                    "mismatches")
+    return ("no output matches the donated buffer's shape/dtype — "
+            "nothing to alias onto")
+
+
+def check(target: ShardTarget, art: Artifacts) -> List[ShardFinding]:
+    if not (target.donate_argnums and art.lowered_text and art.hlo_text):
+        return []
+    from tools import hlo_lib
+
+    from ..artifacts import declared_donations
+
+    declared = declared_donations(art.lowered_text)
+    out: List[ShardFinding] = []
+    if not declared:
+        out.append(ShardFinding(
+            target.name, RULE, NAME,
+            "no donatable args survived lowering",
+            f"donate_argnums={target.donate_argnums} declared but the "
+            "lowered mesh module carries no tf.aliasing_output/"
+            "jax.buffer_donor attribute — jax found no output to "
+            "reuse any donated buffer for"))
+        return out
+    aliased = hlo_lib.parse_aliased_params(art.hlo_text)
+    by_index = {i.index: i for i in art.in_info}
+    for ix in declared:
+        if ix in aliased:
+            continue
+        inf = by_index.get(ix)
+        shape = (f"{inf.dtype}{list(inf.shape)}" if inf else "?")
+        path = inf.path if inf else f"arg{ix}"
+        detail = f"param {ix} {path}"
+        cause = _cause(inf, art) if inf else "no boundary info"
+        out.append(ShardFinding(
+            target.name, RULE, NAME, detail,
+            f"arg {ix} ({path}, {shape}) was donated but the "
+            "partitioned module's input_output_alias map does not "
+            f"cover it — the donation silently degraded and this "
+            f"buffer is copied every call. {cause}"))
+    return out

@@ -1,0 +1,73 @@
+"""S3: host boundary crossings inside a compiled mesh program.
+
+graftaudit H1 already hunts callbacks in single-device programs; on a
+mesh the stakes are higher — a host round-trip serializes EVERY device
+in the partition against one host thread — and a new hazard appears:
+``jax.device_put`` traced INSIDE the program. In eager code device_put
+is placement; inside jit it becomes a resharding op whose cost
+(cross-device copies, or a full gather to host semantics) is invisible
+at the call site. Placement belongs OUTSIDE the compiled hot path —
+the engine's dispatch layer device_puts against the Partitioner's
+specs before calling the executable; in-program resharding should be
+``with_sharding_constraint``, which is declarative and free when
+already satisfied.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import ShardFinding
+from ..spec import Artifacts, ShardTarget
+
+RULE = "S3"
+NAME = "host-transfer-in-mesh-program"
+
+_HOST_PRIMS = ("pure_callback", "io_callback", "debug_callback",
+               "callback", "infeed", "outfeed", "host_callback")
+_PLACEMENT_PRIMS = ("device_put",)
+
+
+def check(target: ShardTarget, art: Artifacts) -> List[ShardFinding]:
+    from tools.graftaudit.artifacts import iter_subjaxprs
+
+    out: List[ShardFinding] = []
+    seen = set()
+    if art.jaxpr is not None:
+        for eqn in iter_subjaxprs(art.jaxpr.jaxpr):
+            pname = eqn.primitive.name
+            host = any(pname == p or pname.startswith(p + "_")
+                       for p in _HOST_PRIMS)
+            placement = any(pname == p for p in _PLACEMENT_PRIMS)
+            if not (host or placement):
+                continue
+            detail = f"{pname} @ {eqn.source_info.name_stack}"
+            if detail in seen:
+                continue
+            seen.add(detail)
+            if host:
+                msg = (f"'{pname}' traced into the mesh program at "
+                       f"{eqn.source_info.name_stack} — every "
+                       "execution serializes the whole partition "
+                       "against the host")
+            else:
+                msg = (f"'{pname}' traced into the mesh program at "
+                       f"{eqn.source_info.name_stack} — in-program "
+                       "placement is a hidden reshard; move it to the "
+                       "dispatch layer or use with_sharding_constraint")
+            out.append(ShardFinding(target.name, RULE, NAME, detail,
+                                    msg))
+    if art.hlo_text:
+        from tools import hlo_lib
+
+        for rec in hlo_lib.find_host_ops(art.hlo_text):
+            detail = f"hlo:{rec['detail']} @ {rec['op_name']}"
+            if detail in seen:
+                continue
+            seen.add(detail)
+            out.append(ShardFinding(
+                target.name, RULE, NAME, detail,
+                f"compiled mesh module contains host-boundary op "
+                f"'{rec['opcode']}' ({rec['detail']}) at "
+                f"{rec['op_name'] or '(no metadata)'}"))
+    return out

@@ -1,0 +1,121 @@
+"""S2: large values resolved to full replication a mesh axis could
+shard.
+
+GSPMD's default for anything unconstrained is "replicate it" — correct,
+silent, and N× the HBM. Three surfaces, checked coarsest-first (the H5
+byte-band idea applied to replication):
+
+- **boundary values**: entry params / outputs whose resolved sharding
+  is fully replicated, at ``>= target.replicated_bytes_max`` bytes,
+  with at least one dim a >1 mesh axis divides — the axis was RIGHT
+  THERE;
+- **constrained intermediates**: ``with_sharding_constraint(x, P())``
+  sites in the lowered StableHLO (``custom_call @Sharding`` with a
+  ``"{replicated}"`` annotation) at threshold size — an explicit
+  replicate of something big enough to matter gets reviewed, not
+  assumed;
+- **materialized replication**: non-gradient ``all-reduce``s at
+  threshold size in the optimized HLO — the signature of XLA
+  rebuilding a full array on every device (the first real scan caught
+  the two-frame image-concat doing exactly this; see
+  ``RAFTConfig.split_encode``). Gradient reductions are data
+  parallelism's PURPOSE, not a finding: instructions whose ``op_name``
+  marks the backward transpose are skipped.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..finding import ShardFinding
+from ..spec import Artifacts, ShardTarget
+
+RULE = "S2"
+NAME = "replicated-large-value"
+
+#: op_name marker of reverse-mode transpose computations — their
+#: all-reduces ARE the data-parallel gradient reduction
+_GRAD_MARK = "transpose("
+
+_SHARDING_CC_RE = re.compile(
+    r"stablehlo\.custom_call @Sharding\((%[\w#]+)\)\s*"
+    r"\{[^\n]*mhlo\.sharding = \"\{replicated\}\"[^\n]*\}\s*:\s*"
+    r"\(tensor<([^>]+)>\)")
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "i64": 8,
+                "ui64": 8, "i32": 4, "ui32": 4, "i16": 2, "ui16": 2,
+                "i8": 1, "ui8": 1, "i1": 1}
+
+
+def _tensor_bytes(ty: str) -> int:
+    """bytes of a stablehlo ``tensor<...>`` body, e.g. '8x32x3xf32'."""
+    parts = ty.split("x")
+    n = _DTYPE_BYTES.get(parts[-1], 4)
+    for p in parts[:-1]:
+        if p.isdigit():
+            n *= int(p)
+    return n
+
+
+def _shardable(shape, mesh_axes) -> bool:
+    sizes = [s for s in mesh_axes.values() if s > 1]
+    return any(d % s == 0 and d >= s for d in shape for s in sizes)
+
+
+def check(target: ShardTarget, art: Artifacts) -> List[ShardFinding]:
+    out: List[ShardFinding] = []
+    limit = target.replicated_bytes_max
+    for side, infos in (("arg", art.in_info), ("out", art.out_info)):
+        for inf in infos:
+            if not inf.replicated or inf.nbytes < limit:
+                continue
+            if not _shardable(inf.shape, art.mesh_axes):
+                continue
+            detail = f"{side} {inf.index} {inf.path}"
+            out.append(ShardFinding(
+                target.name, RULE, NAME, detail,
+                f"{side} {inf.index} ({inf.path}, {inf.dtype}"
+                f"{list(inf.shape)}, {inf.nbytes:,} bytes) resolved "
+                "fully replicated though a mesh axis divides it — "
+                "every device holds the whole array; declare a "
+                "PartitionSpec or waive with the reason it must "
+                "replicate"))
+    if art.lowered_text:
+        for m in _SHARDING_CC_RE.finditer(art.lowered_text):
+            nbytes = _tensor_bytes(m.group(2))
+            if nbytes < limit:
+                continue
+            detail = f"constrained-replicated tensor<{m.group(2)}>"
+            out.append(ShardFinding(
+                target.name, RULE, NAME, detail,
+                f"with_sharding_constraint pins tensor<{m.group(2)}> "
+                f"({nbytes:,} bytes) to full replication — if the "
+                "constraint is load-bearing, waive it with the reason; "
+                "otherwise name the axis that should shard it"))
+    if art.hlo_text:
+        from tools import hlo_lib
+
+        seen = set()
+        for rec in hlo_lib.find_collectives(art.hlo_text):
+            if rec["opcode"] != "all-reduce":
+                continue
+            if _GRAD_MARK in rec["op_name"]:
+                continue
+            if rec["bytes"] < limit:
+                continue
+            detail = (f"all-reduce {rec['shape']} @ "
+                      f"{rec['op_name'] or '(no op_name)'}")
+            if detail in seen:
+                continue
+            seen.add(detail)
+            out.append(ShardFinding(
+                target.name, RULE, NAME, detail,
+                f"all-reduce materializes {rec['shape']} "
+                f"({rec['bytes']:,} bytes) identically on every device "
+                f"at {rec['op_name'] or '(no op_name)'} — a "
+                "non-gradient reduction this large is a value being "
+                "rebuilt replicated (resharding fallout, e.g. a "
+                "concat/reshape across the sharded dim); restructure "
+                "or waive with the reason"))
+    return out

@@ -1,0 +1,69 @@
+"""S4: partition-spec consistency — specs must name real axes, and
+boundary values must be CONSTRAINED.
+
+Two failure shapes, both silent at runtime:
+
+- a declared spec naming an axis the mesh doesn't have. jax rejects a
+  ``NamedSharding`` built against the wrong mesh loudly, but the
+  DECLARATION layer (the Partitioner's rule table, a config file, a
+  fixture) drifts independently of whichever mesh a deployment builds
+  — the audit holds the two together;
+- an entry parameter with no explicit sharding at all. With parameter
+  propagation off (jax's default for lowered-with-avals programs) XLA
+  resolves it to REPLICATED without a word — the
+  ``with_sharding_constraint`` discipline as a gate: every boundary
+  value above the de-minimis floor either declares its spec or gets
+  reviewed. (The first real scan caught the train step's rng key
+  riding unconstrained; trainer.py now device_puts it replicated on
+  purpose, where a reviewer can see the decision.)
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import ShardFinding
+from ..spec import Artifacts, ShardTarget
+
+RULE = "S4"
+NAME = "spec-inconsistent"
+
+
+def _axes_of(spec_axes) -> List[str]:
+    out = []
+    for entry in spec_axes:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            out.extend(entry)
+        else:
+            out.append(entry)
+    return out
+
+
+def check(target: ShardTarget, art: Artifacts) -> List[ShardFinding]:
+    out: List[ShardFinding] = []
+    for kind, axes in target.declared_specs:
+        missing = [a for a in _axes_of(axes)
+                   if a not in art.mesh_axes]
+        if missing:
+            detail = f"spec {kind} names {missing}"
+            out.append(ShardFinding(
+                target.name, RULE, NAME, detail,
+                f"declared spec for '{kind}' ({tuple(axes)}) names "
+                f"mesh axes {missing} absent from the target's mesh "
+                f"{sorted(art.mesh_axes)} — the declaration drifted "
+                "from the deployment mesh; values under this spec "
+                "will not shard the way the code promises"))
+    for inf in art.in_info:
+        if inf.annotated or inf.nbytes < target.boundary_bytes_min:
+            continue
+        detail = f"unconstrained arg {inf.index} {inf.path}"
+        out.append(ShardFinding(
+            target.name, RULE, NAME, detail,
+            f"arg {inf.index} ({inf.path}, {inf.dtype}"
+            f"{list(inf.shape)}, {inf.nbytes:,} bytes) enters the "
+            "mesh program with no declared sharding — XLA silently "
+            "replicates it; declare the spec (or device_put it "
+            "replicated on purpose, where the decision is visible)"))
+    return out

@@ -1,0 +1,72 @@
+"""S5: uneven shards — axis extents that don't divide their mesh axis.
+
+The ragged-tail lesson at the shard level: when an extent doesn't
+divide the axis that shards it, GSPMD pads the trailing shard and
+every device computes the padded extent — pure waste, billed per
+dispatch/step (arXiv 2604.15464's padding-discipline argument one
+level down). jax rejects the BOUNDARY form with an opaque error at
+dispatch time; the DERIVED form (the 1/8-res feature grid under
+'spatial': H divisible by the axis does not make H/8 divisible)
+compiles fine and silently pads. Targets declare their derived
+extents (``Partitioner.shard_geometry``); the rule reports each
+violation with the wasted bytes per shard so geometry fixes can be
+prioritized by cost.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import ShardFinding
+from ..spec import Artifacts, ShardTarget
+
+RULE = "S5"
+NAME = "uneven-shard-padding"
+
+
+def check(target: ShardTarget, art: Artifacts) -> List[ShardFinding]:
+    out: List[ShardFinding] = []
+    for geo in target.shard_geometry:
+        axis = geo["axis"]
+        size = art.mesh_axes.get(axis, 1)
+        if size <= 1:
+            continue
+        extent = int(geo["extent"])
+        if extent % size == 0:
+            continue
+        per = -(-extent // size)            # padded per-shard extent
+        waste_rows = per * size - extent
+        waste_bytes = waste_rows * int(geo.get("row_bytes", 1))
+        detail = f"geometry {geo['name']} over {axis}"
+        out.append(ShardFinding(
+            target.name, RULE, NAME, detail,
+            f"extent {extent} ({geo['name']}) does not divide mesh "
+            f"axis '{axis}'={size}: GSPMD pads the trailing shard to "
+            f"{per} — {waste_rows} dead rows, ~{waste_bytes:,} wasted "
+            "bytes per dispatch; round the geometry to the shard "
+            "grain or resize the axis"))
+    # boundary form: declared (aval, spec) pairs that would shard
+    # unevenly — jax refuses these at dispatch with an opaque error,
+    # so catching them here turns a runtime failure into a review
+    for side, infos in (("arg", art.in_info), ("out", art.out_info)):
+        for inf in infos:
+            if not inf.spec:
+                continue
+            for dim, entry in enumerate(inf.spec):
+                axes = (entry if isinstance(entry, (tuple, list))
+                        else [entry]) if entry is not None else []
+                k = 1
+                for a in axes:
+                    k *= art.mesh_axes.get(a, 1)
+                if k > 1 and dim < len(inf.shape) \
+                        and inf.shape[dim] % k:
+                    detail = (f"{side} {inf.index} {inf.path} "
+                              f"dim {dim}")
+                    out.append(ShardFinding(
+                        target.name, RULE, NAME, detail,
+                        f"{side} {inf.index} ({inf.path}) dim {dim} "
+                        f"extent {inf.shape[dim]} does not divide its "
+                        f"sharding axes {axes} (total {k}) — jax "
+                        "rejects this at dispatch; fix the bucket "
+                        "geometry"))
+    return out

@@ -1,0 +1,126 @@
+"""Shard-audit target declarations: what compiles, and what is waived.
+
+A ``ShardTarget`` names one real partitioned program (the data-parallel
+train step, the pjit-sharded serve trace) plus the *declared* sharding
+discipline the audit holds it to: which args it donates (S6), which
+boundary specs it promises (S4), which derived extents must divide the
+mesh (S5), how many replicated bytes a boundary value may carry (S2).
+
+``Waiver`` is graftaudit's pragma analog, verbatim: rule id + a
+substring of the finding's ``detail`` + a REQUIRED justification,
+reviewed where the target is declared. Waivers are for
+intentional-by-design sharding (weights replicated under data
+parallelism; the backward scan's gradient all-reduces the TPU pass
+pipeline sinks), never "fix later" — that is the shrink-only baseline's
+job, and this tier ships with it EMPTY.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: 64 KiB: the default ceiling for a fully-replicated value a mesh
+#: axis could shard (S2). Sized between the biggest legitimately-tiny
+#: boundary values at audit shapes (scalars, rng, 1/8-res flow rows —
+#: well under 16 KiB) and the smallest replication accident the first
+#: scan caught (the ~96 KiB image-concat all-reduce at 32x32 audit
+#: shapes — real traffic multiplies it by the request geometry);
+#: re-anchored against real sharded TPU HLO by the ``shard_audit_r6``
+#: rung.
+DEFAULT_REPLICATED_BYTES_MAX = 64 << 10
+
+#: de-minimis floor for S4's unconstrained-boundary check: scalars and
+#: tiny host knobs below this ride replicated for free; anything bigger
+#: must DECLARE its sharding (with_sharding_constraint discipline).
+DEFAULT_BOUNDARY_BYTES_MIN = 4096
+
+
+@dataclass(frozen=True)
+class Waiver:
+    rule: str      # "S2"
+    match: str     # substring of the finding's detail
+    reason: str    # justification — empty reasons are rejected
+
+    def __post_init__(self):
+        if not self.reason.strip():
+            raise ValueError(
+                f"waiver for {self.rule} ({self.match!r}) has no "
+                "justification — waivers document intent or they are "
+                "just silent baselining")
+
+
+@dataclass(frozen=True)
+class ShardTarget:
+    """One audited partitioned program.
+
+    ``kind="trace"``: ``build()`` returns ``(fn, args, mesh)`` —
+    positional example args (``jax.ShapeDtypeStruct``s carrying
+    ``NamedSharding``s, or real arrays). The driver traces the jaxpr,
+    lowers with ``donate_argnums``, compiles on the mesh, and records
+    per-flat-arg sharding info for the boundary rules.
+
+    ``kind="decl"``: ``build()`` returns ``mesh`` only — a
+    declaration-level target (specs + geometry, no program). S4/S5
+    audit these without compiling anything; jax itself would reject
+    e.g. an uneven boundary sharding with an opaque error long after
+    the mistake was made, so the decl tier is where geometry fixtures
+    and pre-flight checks live.
+    """
+
+    name: str
+    build: Callable
+    kind: str = "trace"
+    donate_argnums: Tuple[int, ...] = ()
+    #: (value kind, per-dim axis names) pairs the program promises —
+    #: normally ``Partitioner.declared_specs()`` so the audit checks
+    #: the very table the runtime shards with (S4)
+    declared_specs: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = ()
+    #: derived extents that must divide their mesh axis (S5): dicts of
+    #: ``{name, extent, axis, row_bytes}`` — normally
+    #: ``Partitioner.shard_geometry(bucket)``
+    shard_geometry: Tuple[Dict, ...] = ()
+    replicated_bytes_max: int = DEFAULT_REPLICATED_BYTES_MAX
+    boundary_bytes_min: int = DEFAULT_BOUNDARY_BYTES_MIN
+    compiled: bool = True            # False: jaxpr/lowered tier only
+    waivers: Tuple[Waiver, ...] = ()
+    notes: str = ""
+
+    def waived(self, rule: str, detail: str) -> bool:
+        return any(w.rule == rule and w.match in detail
+                   for w in self.waivers)
+
+
+@dataclass
+class ArgInfo:
+    """One flat boundary value (entry parameter or output) of a
+    compiled mesh program. ``spec`` is the resolved PartitionSpec as a
+    tuple of per-dim entries (None / axis name / tuple of axis names);
+    ``annotated`` records whether the LOWERED module carried an explicit
+    ``mhlo.sharding`` for it (inputs only — XLA resolves unannotated
+    params to replicated, silently: the S4 hazard)."""
+
+    index: int
+    path: str
+    shape: Tuple[int, ...]
+    dtype: str
+    nbytes: int
+    spec: Optional[Tuple] = None
+    replicated: bool = False
+    annotated: bool = True
+
+
+@dataclass
+class Artifacts:
+    """Everything the rules see for one target. ``mesh_axes`` maps axis
+    name -> size for the mesh the target built; the texts are jax's
+    lowered StableHLO and XLA's optimized (SPMD-partitioned) HLO;
+    ``in_info``/``out_info`` are per-flat-boundary-value records."""
+
+    jaxpr: object = None
+    lowered_text: str = ""
+    hlo_text: str = ""
+    mesh_axes: Dict[str, int] = field(default_factory=dict)
+    in_info: List[ArgInfo] = field(default_factory=list)
+    out_info: List[ArgInfo] = field(default_factory=list)
+    seconds: float = 0.0

@@ -1,0 +1,253 @@
+"""The repo's real shard-audit targets: the data-parallel train step
+and the pjit-sharded serve trace.
+
+Shapes are tiny (32x32, batch 4 over a data=4 mesh, 2 refinement
+iterations): sharding structure — where collectives land, what
+replicates, whether donations survive partitioning — is decided by
+program structure and the spec table, not by scale. The one
+scale-sensitive artifact is the S2 byte threshold, pinned per target
+here and re-anchored against real sharded TPU HLO by the
+``shard_audit_r6`` rung.
+
+Both targets pull their specs from ``parallel.partitioner.Partitioner``
+— the audit checks the SAME table the runtime shards with, which is
+the point: drift between what the code promises and what the mesh can
+do fails this gate, not a 3 a.m. page.
+
+First-scan findings, FIXED at the site rather than baselined (the
+graftlint/graftaudit/graftthread arc, one tier up):
+
+- S2: the two-frame batch-concat encode redistributed every image row
+  per step (XLA materialized the concat replicated via
+  dynamic-update-slice + all-reduce, then collective-permuted the
+  fmap halves back) → ``RAFTConfig.split_encode``, turned on by
+  ``mesh_model_config`` wherever the 'data' axis is >1;
+- S4: the train step's rng key entered the program unconstrained
+  (silently replicated) → trainer.py now device_puts it replicated
+  where a reviewer can see the decision.
+
+What remains waived below is intentional-by-design, with the reason
+at the declaration.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .artifacts import ensure_mesh_cpu
+from .spec import ShardTarget, Waiver
+
+_IMAGE_HW = (32, 32)
+_ITERS = 2
+_BATCH = 4     # one whole example per 'data' shard at the audit mesh
+
+#: weights/optimizer state replicated by design: every device runs the
+#: whole net over its batch rows — plain data parallelism. Sharded
+#: (FSDP-style) state is the ROADMAP's next axis, not a default.
+#: The match is the STATE TREE's path prefix, hitting exactly values
+#: inside the first positional arg's (and, for the train step, the
+#: first output's) pytree — the train state is a flax struct so its
+#: leaves render attr-style (``arg 4 [0].params['cnet']...``,
+#: ``out 12 [0].params...``); the serve weights are a plain dict
+#: (``arg 33 [0]['params']...``). It must NOT be a bare "arg"/"out":
+#: that would waive EVERY S2 boundary finding (a dropped frames
+#: sharding, a new unsharded input) and kill the rule's surface on
+#: these targets.
+_W_STATE = Waiver(
+    "S2", " [0].",
+    "the train state tree (params + opt state, arg 0 in and out) is "
+    "replicated by design under data parallelism; FSDP-style sharded "
+    "state is a ROADMAP item — this waiver is the marker to drop "
+    "when it lands")
+_W_WEIGHTS = Waiver(
+    "S2", " [0][",
+    "the serving weight tree (arg 0) is replicated by design: every "
+    "device runs the whole net over its batch rows; weight-sharded "
+    "serving is the 4K-frame spatial regime, not this seam")
+
+#: the backward scan's per-iteration gradient all-reduces: XLA's CPU
+#: pipeline leaves the scan-carried weight-grad reductions inside the
+#: transpose loop body; the TPU pipeline sinks loop-accumulated
+#: all-reduces out of the while (WhileLoopAllReduceCodeMotion), which
+#: is the deployment this audits for. Scoped to the transpose op_names
+#: so FORWARD-loop comm — the serving hazard — still gates. The
+#: ``shard_audit_r6`` rung captures real sharded TPU HLO to verify the
+#: sink and retire or tighten this waiver.
+_W_BWD_SCAN = Waiver(
+    "S1", "transpose(",
+    "per-iteration weight-grad all-reduces in the backward scan are a "
+    "forced-CPU-mesh artifact: the TPU pass pipeline sinks "
+    "loop-accumulated reductions (WhileLoopAllReduceCodeMotion); "
+    "re-anchored on real sharded TPU HLO by shard_audit_r6")
+
+
+def _get_jax(n_devices: int, force_cpu: bool):
+    """The gate builds on the forced CPU mesh; the ``shard_audit_r6``
+    on-chip rung passes ``force_cpu=False`` to compile the SAME
+    recipes on the real backend's devices (one builder, two
+    platforms — the re-anchoring evidence must come from the exact
+    program the gate audits)."""
+    if force_cpu:
+        return ensure_mesh_cpu(n_devices)
+    import jax
+    return jax
+
+
+def _build_train_step_dp(image_hw=_IMAGE_HW, batch=_BATCH, iters=_ITERS,
+                         n_devices=4, force_cpu=True):
+    def build():
+        jax = _get_jax(n_devices, force_cpu)
+        import jax.numpy as jnp
+
+        from raft_tpu.config import RAFTConfig, TrainConfig
+        from raft_tpu.parallel.mesh import make_mesh
+        from raft_tpu.parallel.partitioner import (Partitioner,
+                                                   mesh_model_config)
+        from raft_tpu.training.train_step import (create_train_state,
+                                                  make_train_step)
+
+        mesh = make_mesh(n_devices, spatial=1)
+        part = Partitioner(mesh)
+        cfg = mesh_model_config(RAFTConfig(), mesh)
+        h, w = image_hw
+        tc = TrainConfig(iters=iters, batch_size=batch,
+                         image_size=(h, w))
+        rng = jax.random.PRNGKey(0)
+        # avals only — the audit lowers/compiles against shapes +
+        # shardings, it never runs the step
+        state = jax.eval_shape(
+            lambda: create_train_state(cfg, tc, rng,
+                                       image_hw=(h, w)))
+        state = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=part.replicated),
+            state)
+        frames = part.sharding("frames")
+        b = {
+            "image1": jax.ShapeDtypeStruct((batch, h, w, 3), jnp.uint8,
+                                           sharding=frames),
+            "image2": jax.ShapeDtypeStruct((batch, h, w, 3), jnp.uint8,
+                                           sharding=frames),
+            "flow": jax.ShapeDtypeStruct((batch, h, w, 2), jnp.float32,
+                                         sharding=part.sharding("flow")),
+            "valid": jax.ShapeDtypeStruct((batch, h, w), jnp.uint8,
+                                          sharding=part.sharding("valid")),
+        }
+        # the rng boundary is DECLARED replicated (trainer.py does the
+        # same device_put) — the first-scan S4 fix, kept fixed
+        rngspec = jax.ShapeDtypeStruct(rng.shape, rng.dtype,
+                                       sharding=part.replicated)
+        return (make_train_step(cfg, tc), (state, b, rngspec), mesh)
+    return build
+
+
+def _build_serve_shard(image_hw=_IMAGE_HW, batch=_BATCH, iters=_ITERS,
+                       n_devices=4, force_cpu=True):
+    def build():
+        jax = _get_jax(n_devices, force_cpu)
+        import jax.numpy as jnp
+
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.models import RAFT
+        from raft_tpu.parallel.mesh import make_mesh
+        from raft_tpu.parallel.partitioner import (Partitioner,
+                                                   mesh_model_config)
+
+        mesh = make_mesh(n_devices, spatial=1)
+        part = Partitioner(mesh)
+        cfg = mesh_model_config(RAFTConfig(), mesh)
+        model = RAFT(cfg)
+        h, w = image_hw
+        # the deployed fan-out recipe this PR opens
+        # (RAFTEngine(mesh=..., warm_start=True, wire="u8")): uint8
+        # frames batch-sharded over 'data', on-device normalize, the
+        # 1/8-res flow_init donated to its same-shaped (and
+        # same-SHARDED) flow_low output — S6 verifies the alias
+        # survives partitioning
+        img = jax.ShapeDtypeStruct((batch, h, w, 3), jnp.uint8,
+                                   sharding=part.sharding("frames"))
+        finit = jax.ShapeDtypeStruct((batch, h // 8, w // 8, 2),
+                                     jnp.float32,
+                                     sharding=part.sharding("flow_init"))
+        variables = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, h, w, 3)),
+                               jnp.zeros((1, h, w, 3)), iters=1))
+        variables = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=part.replicated),
+            variables)
+
+        def serve(variables, image1, image2, flow_init):
+            flow_low, flow_up = model.apply(
+                variables, image1, image2, iters=iters,
+                flow_init=flow_init, test_mode=True)
+            return flow_low, flow_up
+
+        return serve, (variables, img, img, finit), mesh
+    return build
+
+
+def _audit_partitioner():
+    """The committed spec table + audit-bucket geometry, for the
+    declaration-tier rules. A LITERAL MIRROR of
+    ``Partitioner.declared_specs()`` / ``Partitioner.shard_geometry
+    ((4, 32, 32))`` on purpose: building the real Partitioner needs a
+    mesh and therefore jax, and this module must stay importable
+    jax-free (the warm cache path answers with no jax import at all).
+    Drift between the mirror and the live methods is itself a gate
+    failure — ``tests/test_graftshard.py::
+    test_targets_declare_the_partitioner_table`` pins both halves."""
+    specs = (
+        ("frames", ("data", "spatial", None, None)),
+        ("flow_init", ("data", "spatial", None, None)),
+        ("flow", ("data", "spatial", None, None)),
+        ("valid", ("data", "spatial", None)),
+        ("weights", ()),
+    )
+    h, w = _IMAGE_HW
+    geometry = (
+        {"name": f"batch {_BATCH}", "extent": _BATCH, "axis": "data",
+         "row_bytes": h * w * 3 * 4},
+        {"name": f"image-height {h}", "extent": h, "axis": "spatial",
+         "row_bytes": _BATCH * w * 3 * 4},
+        # feature rows carry the basic fnet's 256 channels — the
+        # dominant per-row tensor a padded shard wastes whole
+        {"name": f"feature-height {h}//8", "extent": h // 8,
+         "axis": "spatial", "row_bytes": _BATCH * (w // 8) * 256 * 4},
+    )
+    return specs, geometry
+
+
+def build_targets() -> List[ShardTarget]:
+    specs, geometry = _audit_partitioner()
+    return [
+        ShardTarget(
+            name="train_step_dp",
+            build=_build_train_step_dp(),
+            donate_argnums=(0,),   # trainer.py jits with donate (0,);
+            #                        state in AND out replicated — the
+            #                        alias must survive partitioning
+            declared_specs=specs,
+            shard_geometry=geometry,
+            waivers=(_W_STATE, _W_BWD_SCAN),
+            notes="data-parallel train step on the (data=4, spatial=1) "
+                  "forced CPU mesh: the raft_tpu/parallel recipe "
+                  "(replicated state, shard_batch layouts, declared "
+                  "rng) exactly as trainer.py builds it"),
+        ShardTarget(
+            name="serve_shard",
+            build=_build_serve_shard(),
+            donate_argnums=(3,),   # flow_init -> flow_low: the u8 warm
+            #                        engine's zero-copy recurrence,
+            #                        sharded — S6 proves the alias
+            #                        survives partitioning
+            declared_specs=specs,
+            shard_geometry=geometry,
+            waivers=(_W_WEIGHTS,),
+            notes="pjit-sharded serve trace (the "
+                  "RAFTEngine(mesh=..., warm_start=True, wire='u8') "
+                  "program): batch over 'data', weights replicated, "
+                  "donated flow_init — the fan-out seam's first brick, "
+                  "audited before any multi-device config ships"),
+    ]

@@ -317,6 +317,90 @@ def find_large_constants(text: str, min_bytes: int) -> List[dict]:
     return out
 
 
+# -- sharding-tier parsers (graftshard consumers) --------------------------
+
+#: cross-device communication opcodes GSPMD inserts when partitioning;
+#: async forms (``all-reduce-start``/``-done``) normalize onto these
+COLLECTIVE_OPCODES = {"all-reduce", "all-gather", "all-to-all",
+                      "collective-permute", "reduce-scatter",
+                      "collective-broadcast", "ragged-all-to-all"}
+
+
+def _norm_collective(opcode: str) -> Optional[str]:
+    base = re.sub(r"-(start|done)$", "", opcode)
+    return base if base in COLLECTIVE_OPCODES else None
+
+
+def computation_lines(text: str) -> Dict[str, List[str]]:
+    """computation name -> its instruction lines (both HLO dialects —
+    see the module-header note on ``%``/signature differences)."""
+    out: Dict[str, List[str]] = {}
+    cur = None
+    for line in text.splitlines():
+        m = _COMP_RE.match(line)
+        if m:
+            cur = m.group("comp")
+            out[cur] = []
+            continue
+        if line.strip() == "}":
+            cur = None
+        elif cur is not None and line.strip():
+            out[cur].append(line)
+    return out
+
+
+def while_body_computations(text: str) -> Set[str]:
+    """Names of computations executed PER LOOP ITERATION: every
+    ``body=``/``condition=`` region of a ``while``, expanded through
+    the computations those regions call (``calls=``/``to_apply=``) —
+    a collective buried in a called sub-computation of a loop body is
+    still per-iteration comm."""
+    comps = computation_lines(text)
+    roots = set(re.findall(r"(?:body|condition)=%?([\w.\-]+)", text))
+    seen: Set[str] = set()
+    stack = [r for r in roots if r in comps]
+    while stack:
+        c = stack.pop()
+        if c in seen:
+            continue
+        seen.add(c)
+        for line in comps.get(c, ()):
+            for ref in re.findall(r"(?:calls|to_apply|body|condition)"
+                                  r"=%?([\w.\-]+)", line):
+                if ref in comps and ref not in seen:
+                    stack.append(ref)
+    return seen
+
+
+def find_collectives(text: str, within: Optional[Set[str]] = None
+                     ) -> List[dict]:
+    """Collective instruction defs, each ``{name, opcode, shape, bytes,
+    op_name, comp}`` — optionally restricted to the ``within``
+    computations (e.g. :func:`while_body_computations` for the
+    comm-in-loop question)."""
+    out: List[dict] = []
+    for comp, lines in computation_lines(text).items():
+        if within is not None and comp not in within:
+            continue
+        for line in lines:
+            d = _OP_RE.match(line)
+            if not d:
+                continue
+            opcode = _norm_collective(d.group("opcode"))
+            if opcode is None:
+                continue
+            meta = _META_RE.search(line)
+            out.append({
+                "name": d.group("name"),
+                "opcode": opcode,
+                "shape": d.group("shape"),
+                "bytes": shape_bytes(d.group("shape")),
+                "op_name": meta.group("op") if meta else "",
+                "comp": comp,
+            })
+    return out
+
+
 def find_host_ops(text: str) -> List[dict]:
     """Instructions that cross the host boundary inside the module:
     infeed/outfeed/send/recv and custom-calls whose target names a host

@@ -108,6 +108,15 @@ if [ -e "$MARK/hlo_dump_r6" ]; then
 print(p('$HLO_DUMP'))" 2>/dev/null || echo unreadable)"
 fi
 
+# ---- sharded HLO capture for graftshard re-anchoring (PR 15) ----------
+# Compiles the two graftshard mesh programs on the REAL devices and
+# answers: does the TPU pipeline sink the backward scan's grad
+# all-reduces (S1 waiver evidence)? What are the real collective sizes
+# (S2) / shard extents (S5)? A single-chip window self-reports and
+# no-ops — the rung only earns its slot on a slice. Compile-only.
+step shard_audit_r6 1500 python tools/shard_audit_onchip.py \
+    --out /root/.cache/raft_tpu/r6_shard_audit --image-hw 64,64
+
 # ---- secondary: fused at the b10 memory edge (the Pallas epilogues
 # drop gate intermediates from the scan's saved-residual stack, so the
 # fused path may fit a batch the xla path OOMs at) -----------------------
