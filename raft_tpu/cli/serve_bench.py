@@ -184,7 +184,7 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
               ragged=False, capacity_classes=None,
               fault_plan=None, recover_s=0.0,
               metrics_path=None, trace_path=None, trace_sample=1.0,
-              tracer=None, seed=0, engine=None):
+              tracer=None, seed=0, engine=None, aot_cache=None):
     """The drill as a library call (tests reuse it, and may pass a
     prebuilt warm-start ``engine`` to share compiles across drills).
     Returns the summary dict the CLI prints.
@@ -209,6 +209,15 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     without the flag compares ``executables`` (O(1) vs O(shapes)),
     ``capacity_fill``, ``cross_shape_coalesce_rate`` and
     ``padding_waste_ratio``.
+
+    ``aot_cache`` (a directory path) arms the serialized-executable
+    cache (serving/aot.py): the engine's precompile LOADS any bucket
+    whose artifact is already in the dir instead of compiling, and
+    stores what it does compile — the load-vs-compile cold-start A/B
+    the ``--aot-cache`` rung runs twice against one dir. When armed
+    the summary grows ``aot_hits``/``aot_misses``/``compiles``/
+    ``compiles_avoided`` (from ``engine.aot_stats()``); off, the
+    summary is byte-identical to before.
 
     ``trace_path`` arms request-scoped tracing (serving/trace.py):
     spans append there under ``trace_sample`` with always-keep-tail
@@ -241,7 +250,8 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                 variables, cfg, iters=iters, precompile=True,
                 warm_start=True, wire=wire, ragged=True,
                 capacity_classes=_capacity_envelope(
-                    shapes, capacity_classes, bucket_batch))
+                    shapes, capacity_classes, bucket_batch),
+                aot_cache=aot_cache)
         else:
             # one documented bucket per distinct ÷8-padded request shape
             envelope = sorted({(bucket_batch, _ceil8(h), _ceil8(w))
@@ -249,7 +259,8 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
             engine = RAFTEngine(variables, cfg, iters=iters,
                                 envelope=envelope, precompile=True,
                                 warm_start=True, wire=wire,
-                                feature_cache=feature_cache)
+                                feature_cache=feature_cache,
+                                aot_cache=aot_cache)
     _n_exec = getattr(engine, "executable_count",
                       lambda: len(engine._compiled))
     documented = _n_exec()
@@ -464,6 +475,18 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
         "wall_s": round(wall, 3),
         "pairs_per_s": round(total_served / wall, 2) if wall else 0.0,
     }
+    aot = (engine.aot_stats() if hasattr(engine, "aot_stats")
+           else {"enabled": 0})
+    if aot.get("enabled"):
+        # load-vs-compile A/B surface (keys absent with the cache off
+        # — the summary stays byte-identical to the uncached drill):
+        # the second run against a warm dir must report
+        # compiles == 0 and compiles_avoided == the first run's
+        # compile count
+        summary["aot_hits"] = aot["aot_hits"]
+        summary["aot_misses"] = aot["aot_misses"]
+        summary["compiles"] = aot["compiles"]
+        summary["compiles_avoided"] = aot["compiles_avoided"]
     if tracer is not None:
         # request-tracing surface (key absent when tracing is off —
         # the summary stays byte-identical to the untraced drill):
@@ -520,7 +543,8 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                     feature_cache=False, cache_capacity=256,
                     ragged=False, capacity_classes=None,
                     deadline_s=None, seed=0, metrics_path=None,
-                    trace_path=None, trace_sample=1.0, engine=None):
+                    trace_path=None, trace_sample=1.0, engine=None,
+                    aot_cache=None):
     """``rounds`` randomized fault rounds + one clean recovery round
     over ONE shared engine (dropped buckets recompile lazily across
     rounds), asserting the invariants after each. Returns the summary
@@ -538,7 +562,16 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
     the chaos invariants grow the span/accounting identity: zero open
     spans after the drill (every accepted request closed exactly one
     span) — the wedge/eviction/deadline outcome tags the test layer
-    reconciles bucket-for-bucket."""
+    reconciles bucket-for-bucket.
+
+    ``aot_cache`` arms the serialized-executable cache AND its fault
+    site: every chaos round's plan gains an ``aot.load`` corruption
+    entry, so when a wedge-dropped bucket recompiles it first hits a
+    just-corrupted artifact — the drilled contract is a clean
+    miss-and-recompile (the same violations machinery pins it: no
+    stranded futures, executables back at the documented count, and a
+    corrupted entry is REPLACED on the re-store, proven by the clean
+    round loading it again)."""
     from raft_tpu.serving.engine import RAFTEngine
 
     if ragged and feature_cache:
@@ -576,14 +609,15 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
             engine = RAFTEngine(
                 variables, cfg, iters=iters, precompile=True,
                 warm_start=True, wire=wire, ragged=True,
-                capacity_classes=classes)
+                capacity_classes=classes, aot_cache=aot_cache)
         else:
             envelope = sorted({(bucket_batch, _ceil8(h), _ceil8(w))
                                for h, w in shapes})
             engine = RAFTEngine(variables, cfg, iters=iters,
                                 envelope=envelope, precompile=True,
                                 warm_start=True, exact_shapes=True,
-                                wire=wire, feature_cache=feature_cache)
+                                wire=wire, feature_cache=feature_cache,
+                                aot_cache=aot_cache)
     _n_exec = getattr(engine, "executable_count",
                       lambda: len(engine._compiled))
     documented = _n_exec()
@@ -612,8 +646,17 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                   tracer=tracer, engine=engine)
     sites = (CHAOS_SITES_PIPELINED if pipeline_depth > 1
              else CHAOS_SITES)
+    aot_armed = bool(getattr(engine, "aot_stats",
+                             lambda: {"enabled": 0})().get("enabled"))
     for r in range(rounds):
         plan = chaos_plan(rng, hang_s=hang_s, sites=sites)
+        if aot_armed:
+            # cached-artifact bit rot, mid-drill: the first load this
+            # round (a wedge-dropped bucket recompiling) reads a
+            # just-corrupted entry and must take the clean
+            # miss-and-recompile path
+            plan["faults"].append({"site": "aot.load", "kind": "corrupt",
+                                   "at": 1, "count": 1})
         s = run_drill(variables, cfg, seed=seed + 17 * r,
                       fault_plan=plan, **common)
         s["round"] = r
@@ -673,6 +716,8 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
         "totals": totals,
         "per_round": per_round,
     }
+    if aot_armed:
+        out["aot"] = engine.aot_stats()
     if tracer is not None:
         # whole-drill trace view (the per-round blocks carry only
         # their OWN refs/accounting — the shared ledger counters and
@@ -1384,6 +1429,14 @@ def main(argv=None):
                    help="interactive-only slice of the admission "
                         "budget (default N/4): batch-class traffic "
                         "can never take the last R tokens")
+    p.add_argument("--aot-cache", default=None, metavar="DIR",
+                   help="serialized-executable cache dir "
+                        "(serving/aot.py): precompile LOADS artifacts "
+                        "already there instead of compiling, stores "
+                        "what it compiles; the summary grows aot_hits/"
+                        "aot_misses/compiles/compiles_avoided. Run the "
+                        "same drill twice against one dir for the "
+                        "load-vs-compile cold-start A/B")
     p.add_argument("--log-dir", default=None,
                    help="append the metrics snapshot to "
                         "<log-dir>/metrics.jsonl")
@@ -1456,6 +1509,12 @@ def main(argv=None):
     if (args.guardian or args.admission_budget) and not args.models:
         raise SystemExit("--guardian/--admission-budget need --models "
                          "(they are ModelRegistry features)")
+    if args.aot_cache and args.models:
+        raise SystemExit("--aot-cache with --models is not wired yet "
+                         "(the registry drill builds its engines "
+                         "internally; use ModelRegistry's "
+                         "artifact_dir= in library code) — run the "
+                         "single-model drills against the cache dir")
     guardian_policy = None
     if args.guardian:
         guardian_policy = _parse_slo(args.slo) if args.slo else {}
@@ -1583,7 +1642,7 @@ def main(argv=None):
             ragged=args.ragged, capacity_classes=capacity_classes,
             max_queue=args.queue, seed=args.seed,
             metrics_path=metrics_path, trace_path=trace_path,
-            trace_sample=trace_sample)
+            trace_sample=trace_sample, aot_cache=args.aot_cache)
         print(json.dumps(summary), flush=True)
         if summary["violations"]:
             raise SystemExit(1)
@@ -1608,7 +1667,8 @@ def main(argv=None):
         ragged=args.ragged, capacity_classes=capacity_classes,
         recover_s=args.recover_s,
         metrics_path=metrics_path, trace_path=trace_path,
-        trace_sample=trace_sample, seed=args.seed)
+        trace_sample=trace_sample, seed=args.seed,
+        aot_cache=args.aot_cache)
     print(json.dumps(summary), flush=True)
 
 

@@ -1,0 +1,391 @@
+"""AOT executable cache: serialized bucket/class programs behind a
+content-addressed on-disk store.
+
+The reference deployment serializes inference programs once and ships
+artifacts (ONNX -> TensorRT, ``cvt2trt.*``); this module is the
+JAX-native analog for the serving engine's bucket executables. A
+100-replica rollout that recompiles every bucket 100x pays cold-start
+in compile time; with a warm artifact dir the engine LOADS the bytes
+XLA already produced (``jax.experimental.serialize_executable``) and
+performs zero compiles for precompiled signatures.
+
+Trust model — a serialized executable is a new boundary:
+
+- The CACHE KEY is the full provenance of the program: a content
+  fingerprint of the weights (not a per-process counter — a restarting
+  supervisor must re-derive the same key), bucket geometry + program
+  kind, wire dtype, the donation signature, the partition-spec hash,
+  config/iters, and the jax/jaxlib versions + platform. Canonical-JSON
+  sha256 of that dict names the entry directory.
+- Every entry carries a MANIFEST sidecar: the full key (checked
+  verbatim on load — a blob sitting at the wrong digest never loads),
+  the blob's sha256 (checked before a single byte is unpickled), and
+  the calling-convention signature (flat in/out avals + donated flat
+  params) so the ``tools/graftexport`` tier can audit drift against
+  the engine's live signature table.
+- ANY verification failure — unreadable or torn manifest, key
+  mismatch, version skew, hash mismatch, deserialization error — is a
+  clean MISS: :meth:`AOTCache.load` returns ``None`` and the caller
+  recompiles. No failure mode loads a wrong executable, and no failure
+  mode raises into the serving path.
+- Writes are atomic (publish a fully-written temp dir via ``rename``)
+  and first-insert-wins; an existing entry that fails verification is
+  replaced, so one corrupted blob cannot wedge a digest forever.
+
+Fault site: ``aot.load`` (see ``raft_tpu/testing/faults.py``) —
+``fault_file`` corrupts the entry on disk before the read and
+``fault_point`` raises inside the verification scope, so the chaos
+drill can assert both read as miss-and-recompile.
+
+The store is an accelerator, never a correctness gate: ``store``
+swallows serialization/IO errors (some programs — e.g. ones carrying
+host callbacks — cannot serialize; the engine simply keeps its
+in-process executable).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.testing.faults import fault_file, fault_point
+
+#: bump when the entry layout or pickle payload shape changes — old
+#: entries then read as miss, never as a misparse
+AOT_FORMAT = "jax_serialize_executable_v1"
+
+#: every component a complete cache key must carry.
+#: ``tools/graftexport`` rule E1 audits written manifests against a
+#: literal mirror of this set (pinned equal by tests/test_graftexport)
+#: — a key missing any of these is a stale-load hazard: two programs
+#: differing only in the missing component would collide on one digest.
+REQUIRED_KEY_FIELDS = frozenset({
+    "format",     # AOT_FORMAT — layout/payload version
+    "program",    # serve | serve_warm | serve_cached | serve_ragged...
+    "weights",    # content fingerprint of the weight tree
+    "geometry",   # bucket/class (batch, H, W)
+    "wire",       # f32 | u8 boundary dtype
+    "iters",      # refinement iterations baked into the trace
+    "config",     # model config fingerprint
+    "donations",  # donate_argnums of the jitted program
+    "partition",  # mesh/spec hash, or "single"
+    "jax",        # jax version that compiled the blob
+    "jaxlib",     # jaxlib version
+    "platform",   # backend platform the executable targets
+})
+
+_MANIFEST = "manifest.json"
+_BLOB = "executable.bin"
+
+
+_PC_LOCK = threading.Lock()
+_PC_DEPTH = 0
+_PC_PRIOR = True
+
+
+@contextlib.contextmanager
+def fresh_compile():
+    """Disable jax's own persistent compile cache for the scope of a
+    compile that will be SERIALIZED into this store.
+
+    A persistent-cache hit hands back an executable that was itself
+    DESERIALIZED; re-serializing it is a second-generation payload,
+    and those fail ``deserialize_and_load`` with ``Symbols not
+    found`` in any process without a live fresh-compiled twin to
+    borrow object code from (jax 0.4.37 CPU thunk runtime) — a
+    stillborn artifact that every fresh replica reads as a miss, so
+    the zero-compile warm start silently never happens. Compiling
+    fresh makes every stored payload a first-generation
+    serialization of a backend compile, which loads deterministically
+    anywhere. The AOT store replaces that cache for engine programs
+    anyway (content-addressed one level up, with provenance).
+
+    Flipping ``jax_enable_compilation_cache`` alone is NOT enough:
+    ``compilation_cache.is_cache_used`` memoizes enabled-ness on the
+    first compile of the process, so the flag flip must be paired
+    with ``reset_cache()`` (entry AND exit — exit re-arms the cache
+    for ordinary compiles). Depth-counted so concurrent engine
+    compiles (which deliberately run outside the engine lock) nest
+    without restoring the flag early."""
+    import jax
+
+    def _reset_cache_probe():
+        # drop the per-process "is the cache used" memo so the flag
+        # value is re-read at the next compile
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — jax-internal API, best effort
+            pass
+
+    global _PC_DEPTH, _PC_PRIOR
+    with _PC_LOCK:
+        if _PC_DEPTH == 0:
+            _PC_PRIOR = bool(jax.config.jax_enable_compilation_cache)
+            if _PC_PRIOR:
+                jax.config.update("jax_enable_compilation_cache", False)
+                _reset_cache_probe()
+        _PC_DEPTH += 1
+    try:
+        yield
+    finally:
+        with _PC_LOCK:
+            _PC_DEPTH -= 1
+            if _PC_DEPTH == 0 and _PC_PRIOR:
+                jax.config.update("jax_enable_compilation_cache", True)
+                _reset_cache_probe()
+
+
+# -- fingerprints ---------------------------------------------------------
+
+def weights_fingerprint(variables) -> str:
+    """Content hash over the weight pytree: treedef + per-leaf path,
+    shape, dtype, and bytes. Derivable in any process holding the same
+    checkpoint — the property that makes cross-process warm starts key
+    to the same entries — and guaranteed to change under
+    ``update_weights``/promote, so a swapped model can never load the
+    old model's artifact."""
+    import jax
+    import numpy as np
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(variables)
+    h = hashlib.sha256(str(treedef).encode())
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+def config_fingerprint(config, iters: int) -> str:
+    """Model-architecture component of the key: the dataclass repr is
+    stable and covers every knob that changes the traced program."""
+    h = hashlib.sha256(repr(config).encode())
+    h.update(str(int(iters)).encode())
+    return h.hexdigest()[:16]
+
+
+def partition_fingerprint(mesh, declared_specs=()) -> str:
+    """Mesh axes/sizes + the declared spec table, or ``"single"`` for
+    the single-device engine. Includes device COUNT: an executable
+    partitioned for 4 devices must never load into an 8-device
+    assembly."""
+    if mesh is None:
+        return "single"
+    h = hashlib.sha256()
+    h.update(repr(tuple(mesh.axis_names)).encode())
+    h.update(repr(tuple(mesh.devices.shape)).encode())
+    h.update(repr(tuple(declared_specs)).encode())
+    return h.hexdigest()[:16]
+
+
+def declared_donations(lowered_text: str) -> List[int]:
+    """Flat entry-param indices the lowered module marks donatable
+    (``tf.aliasing_output`` / ``jax.buffer_donor``) — the signature's
+    donation half. Split on ``%arg``, not an attribute-dict regex:
+    attrs may nest braces (same parser discipline as
+    ``tools/graftshard/artifacts.py``, kept dependency-free here
+    because serving code must not import the lint tooling)."""
+    try:
+        sig = lowered_text[lowered_text.index("@main("):]
+        sig = sig[:sig.index(") -> ")]
+    except ValueError:
+        return []
+    out = []
+    for chunk in sig.split("%arg")[1:]:
+        ix = chunk.split(":", 1)[0]
+        if ix.isdigit() and ("tf.aliasing_output" in chunk
+                             or "jax.buffer_donor" in chunk):
+            out.append(int(ix))
+    return sorted(out)
+
+
+def _fmt_aval(x) -> str:
+    import jax.numpy as jnp
+
+    shape = ",".join(str(int(d)) for d in jnp.shape(x))
+    return f"{jnp.result_type(x)}[{shape}]"
+
+
+def build_signature(args, lowered) -> Dict:
+    """Calling-convention record for the manifest: flat input
+    shapes/dtypes, flat output shapes/dtypes, and the donated flat
+    params — what graftexport E5 diffs against the engine's live
+    recipe."""
+    import jax
+
+    sig: Dict = {
+        "in": [_fmt_aval(leaf)
+               for leaf in jax.tree_util.tree_leaves(list(args))],
+        "out": [],
+        "donations": [],
+    }
+    try:
+        sig["out"] = [_fmt_aval(o) for o in
+                      jax.tree_util.tree_leaves(lowered.out_info)]
+    except Exception:
+        pass
+    try:
+        sig["donations"] = declared_donations(lowered.as_text())
+    except Exception:
+        pass
+    return sig
+
+
+# -- the cache ------------------------------------------------------------
+
+def key_digest(components: Dict) -> str:
+    """Canonical-JSON sha256 over the component dict — the entry name.
+    Raises on non-JSON components: a key that cannot round-trip through
+    the manifest cannot be verified on load."""
+    blob = json.dumps(components, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class AOTCache:
+    """Content-addressed store of serialized XLA executables.
+
+    Layout: ``root/objects/<digest>/{manifest.json, executable.bin}``
+    where ``digest = sha256(canonical key json)``. The blob is a pickle
+    of ``(serialized_bytes, in_tree, out_tree)`` exactly as
+    ``jax.experimental.serialize_executable.serialize`` returns them.
+
+    Thread-safety: stateless but for monotonic counters; the engine
+    serializes its own compiles per bucket, and concurrent processes
+    racing one digest resolve by atomic rename (first insert wins,
+    both blobs are byte-equivalent by construction of the key).
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(str(root))
+        self.objects = os.path.join(self.root, "objects")
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.last_miss = ""   # why the last load missed (tests/debug)
+
+    def entry_dir(self, components: Dict) -> str:
+        return os.path.join(self.objects, key_digest(components))
+
+    # -- load (never raises, never loads wrong) ---------------------------
+
+    def load(self, components: Dict):
+        """The verified load path: returns a ready-to-call executable,
+        or ``None`` on ANY verification failure. The checks run in
+        trust order — manifest parse, format tag, verbatim key match,
+        blob hash — before the first unpickled byte."""
+        edir = self.entry_dir(components)
+        if not os.path.isdir(edir):
+            return self._miss("absent")
+        # chaos surface: corrupt the artifact before the read...
+        fault_file("aot.load", edir)
+        try:
+            # ...and raise inside the verification scope — both must
+            # read as a clean miss
+            fault_point("aot.load")
+            with open(os.path.join(edir, _MANIFEST),
+                      encoding="utf-8") as f:
+                manifest = json.load(f)
+            if manifest.get("format") != AOT_FORMAT:
+                return self._miss("format skew")
+            if manifest.get("key") != components:
+                return self._miss("key mismatch")
+            with open(os.path.join(edir, _BLOB), "rb") as f:
+                blob = f.read()
+            if hashlib.sha256(blob).hexdigest() != manifest.get("sha256"):
+                return self._miss("blob hash mismatch")
+            from jax.experimental import serialize_executable as se
+
+            payload, in_tree, out_tree = pickle.loads(blob)
+            exe = se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as exc:  # noqa: BLE001 — any failure is a miss
+            return self._miss(f"{type(exc).__name__}: {exc}")
+        self.hits += 1
+        return exe
+
+    def _miss(self, why: str):
+        self.misses += 1
+        self.last_miss = why
+        return None
+
+    # -- store (atomic, best-effort) --------------------------------------
+
+    def store(self, components: Dict, compiled, lowered=None,
+              args: Tuple = ()) -> Optional[str]:
+        """Serialize ``compiled`` under ``components``; returns the
+        entry dir, or ``None`` when the program cannot serialize (host
+        callbacks etc.) or the write fails — the cache accelerates, it
+        never gates."""
+        missing = REQUIRED_KEY_FIELDS - set(components)
+        if missing:
+            raise ValueError(
+                f"aot cache key missing component(s) {sorted(missing)} "
+                "— an incomplete key is a stale-load hazard "
+                "(graftexport E1)")
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload, in_tree, out_tree = se.serialize(compiled)
+            blob = pickle.dumps((payload, in_tree, out_tree),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            manifest = {
+                "format": AOT_FORMAT,
+                "key": components,
+                "sha256": hashlib.sha256(blob).hexdigest(),
+                "blob_bytes": len(blob),
+                "signature": (build_signature(args, lowered)
+                              if lowered is not None else {}),
+            }
+            final = self.entry_dir(components)
+            if os.path.isdir(final):
+                if self._entry_valid(final, components):
+                    return final           # first insert already won
+                shutil.rmtree(final, ignore_errors=True)
+            os.makedirs(self.objects, exist_ok=True)
+            tmp = tempfile.mkdtemp(prefix=".tmp-", dir=self.objects)
+            with open(os.path.join(tmp, _BLOB), "wb") as f:
+                f.write(blob)
+            # manifest LAST: a torn write can only ever lose the
+            # manifest, and an entry without one reads as miss
+            with open(os.path.join(tmp, _MANIFEST), "w",
+                      encoding="utf-8") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+            try:
+                os.rename(tmp, final)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)   # racer won
+            self.stores += 1
+            return final
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _entry_valid(self, edir: str, components: Dict) -> bool:
+        """Cheap integrity read (no unpickle): manifest parses, key
+        matches, blob hash matches."""
+        try:
+            with open(os.path.join(edir, _MANIFEST),
+                      encoding="utf-8") as f:
+                manifest = json.load(f)
+            if (manifest.get("format") != AOT_FORMAT
+                    or manifest.get("key") != components):
+                return False
+            with open(os.path.join(edir, _BLOB), "rb") as f:
+                blob = f.read()
+            return hashlib.sha256(blob).hexdigest() == \
+                manifest.get("sha256")
+        except Exception:  # noqa: BLE001
+            return False
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "stores": self.stores}

@@ -282,7 +282,7 @@ class RAFTEngine:
                  wire: str = "f32", feature_cache: bool = False,
                  ragged: bool = False,
                  capacity_classes: Sequence[Tuple[int, int, int]] = (),
-                 ragged_grain: int = 64):
+                 ragged_grain: int = 64, aot_cache=None):
         """``mesh``: optional ``jax.sharding.Mesh`` (data × spatial axes,
         `parallel.mesh.make_mesh`) — buckets then compile as SPMD
         programs with batch sharded over 'data' and image height over
@@ -371,6 +371,19 @@ class RAFTEngine:
         cleaner zeros-tail semantics, documented in README "Ragged
         serving". Off by default: no ragged table exists and every
         other path is bitwise unchanged.
+
+        ``aot_cache``: optional :class:`raft_tpu.serving.aot.AOTCache`
+        (or a directory path — one is built) — the serialized-executable
+        store. With it armed, ``_get_executable`` probes the cache
+        BEFORE compiling (keyed on weights content + bucket geometry +
+        wire + donation signature + partition hash + config/iters +
+        jax/jaxlib/platform) and a hit loads the ready executable with
+        ZERO XLA compiles; a miss compiles as before and serializes the
+        result for the next process. Any key mismatch or corrupt blob
+        reads as a clean miss-and-recompile — never a wrong load (see
+        aot.py's trust model; ``tools/graftexport`` audits the
+        artifacts). Off (``None``, the default): bitwise the PR-15
+        engine, no on-disk state at all.
         """
         if wire not in ("f32", "u8"):
             raise ValueError(f"wire={wire!r}: choose 'f32' or 'u8'")
@@ -546,6 +559,29 @@ class RAFTEngine:
         else:
             self._fn = jax.jit(serve)
         self._compiled: Dict[Tuple[int, int, int], jax.stages.Compiled] = {}
+
+        # -- AOT executable cache (load-not-compile) ----------------------
+        if aot_cache is not None and not hasattr(aot_cache, "load"):
+            from raft_tpu.serving.aot import AOTCache
+            aot_cache = AOTCache(aot_cache)
+        self._aot = aot_cache
+        #: real XLA compiles this engine performed (cache hits don't
+        #: count) — the zero-compile cold-start pin reads this
+        self.compile_count = 0
+        self.aot_hits = 0
+        self.aot_misses = 0
+        if self._aot is not None:
+            from raft_tpu.serving import aot as _aotmod
+            # content fingerprint, NOT the weights_version counter: a
+            # fresh process must re-derive the same key from the same
+            # checkpoint, and a swapped checkpoint must derive a
+            # DIFFERENT one (the old artifact can never load)
+            self._weights_fp = _aotmod.weights_fingerprint(self.variables)
+            self._config_fp = _aotmod.config_fingerprint(config, iters)
+            self._partition_fp = _aotmod.partition_fingerprint(
+                mesh, self.partitioner.declared_specs()
+                if self.partitioner is not None else ())
+
         for shape in envelope:
             if precompile:
                 self._get_executable(shape)
@@ -627,6 +663,13 @@ class RAFTEngine:
         staged = (jax.device_put(variables, self.partitioner.replicated)
                   if self.mesh is not None
                   else jax.device_put(variables))
+        if self._aot is not None:
+            # outside the lock (hashes the whole tree); the new
+            # fingerprint keys every POST-swap compile to the new
+            # weights — the old checkpoint's artifacts are unreachable
+            # from this engine the moment the swap publishes
+            from raft_tpu.serving import aot as _aotmod
+            new_fp = _aotmod.weights_fingerprint(variables)
         # the swap itself is a single reference assignment under the
         # dispatch lock: an in-flight infer_batch already holds its own
         # snapshot, the next one sees the new tree whole. The version
@@ -635,27 +678,27 @@ class RAFTEngine:
         with self._lock:
             self.variables = staged
             self.weights_version += 1
+            if self._aot is not None:
+                self._weights_fp = new_fp
 
     # -- shape routing ------------------------------------------------------
 
-    def _get_executable(self, shape: Tuple[int, int, int], variables=None,
-                        cached: bool = False, ragged: bool = False):
+    def bucket_program(self, shape: Tuple[int, int, int], variables=None,
+                       cached: bool = False, ragged: bool = False):
+        """``(jitted fn, example args)`` for one bucket/class — the
+        EXACT recipe ``_get_executable`` compiles, exposed so the AOT
+        store records the true calling convention and the
+        ``tools/graftexport`` tier lowers the very program the engine
+        serves (E5 audits manifest signatures against this)."""
         if cached and self._fn_cached is None:
             raise ValueError("cached executables need a "
                              "feature_cache=True engine")
         if ragged and self._fn_ragged is None:
             raise ValueError("ragged executables need a "
                              "ragged=True engine")
-        if ragged:
-            table = self._compiled_ragged
-        else:
-            table = self._compiled_cached if cached else self._compiled
-        with self._lock:
-            if variables is None:
+        if variables is None:
+            with self._lock:
                 variables = self.variables
-            exe = table.get(shape)
-        if exe is not None:
-            return exe
         b, h, w = shape
         if self.mesh is not None:
             self.partitioner.validate_extent(h)
@@ -706,6 +749,76 @@ class RAFTEngine:
                     sharding=(self.partitioner.sharding("flow_init")
                               if self.mesh is not None else None)))
             fn = self._fn
+        return fn, args
+
+    def _aot_key(self, shape: Tuple[int, int, int], cached: bool = False,
+                 ragged: bool = False) -> Dict:
+        """The serialized-executable cache key for one bucket/class:
+        full program provenance, every component derivable by a fresh
+        process holding the same checkpoint (see aot.REQUIRED_KEY_FIELDS
+        — graftexport E1 audits written manifests against it)."""
+        from raft_tpu.serving import aot as _aotmod
+        import jaxlib
+
+        if ragged:
+            program = ("serve_ragged_warm" if self.warm_start
+                       else "serve_ragged")
+            donations = ([5] if self.warm_start and self.wire == "u8"
+                         else [])
+        elif cached:
+            program = "serve_cached"
+            donations = [2, 3, 4]
+        else:
+            program = "serve_warm" if self.warm_start else "serve"
+            donations = ([3] if self.warm_start and self.wire == "u8"
+                         else [])
+        return {
+            "format": _aotmod.AOT_FORMAT,
+            "program": program,
+            "weights": self._weights_fp,
+            "geometry": [int(x) for x in shape],
+            "wire": self.wire,
+            "iters": int(self.iters),
+            "config": self._config_fp,
+            "donations": donations,
+            "partition": self._partition_fp,
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "platform": jax.default_backend(),
+        }
+
+    def _get_executable(self, shape: Tuple[int, int, int], variables=None,
+                        cached: bool = False, ragged: bool = False):
+        if ragged:
+            table = self._compiled_ragged
+        else:
+            table = self._compiled_cached if cached else self._compiled
+        with self._lock:
+            if variables is None:
+                variables = self.variables
+            exe = table.get(shape)
+        if exe is not None:
+            return exe
+        fn, args = self.bucket_program(shape, variables=variables,
+                                       cached=cached, ragged=ragged)
+        key = (self._aot_key(shape, cached=cached, ragged=ragged)
+               if self._aot is not None else None)
+        if key is not None:
+            # load-not-compile: a verified artifact skips XLA entirely.
+            # aot.load NEVER raises and NEVER returns a wrong program —
+            # any mismatch/corruption below falls through to the
+            # compile path (chaos site "aot.load" proves it mid-run)
+            exe = self._aot.load(key)
+            if exe is not None:
+                with self._lock:
+                    self.aot_hits += 1
+                    cur = table.get(shape)
+                    if cur is None:
+                        table[shape] = exe
+                        cur = exe
+                return cur
+            with self._lock:
+                self.aot_misses += 1
         # compile OUTSIDE the lock: minutes on real hardware, and the
         # lock must stay cheap (weight swaps and already-compiled
         # dispatches would stall behind it). The executable is keyed by
@@ -717,7 +830,25 @@ class RAFTEngine:
         # never returns — the wedge the scheduler's dispatch watchdog
         # must survive
         fault_point("engine.compile")
-        exe = fn.lower(*args).compile()
+        with self._lock:
+            self.compile_count += 1
+        if key is not None:
+            # a compile that feeds the store must come from the
+            # BACKEND: a jax-persistent-cache-deserialized executable
+            # serializes to a payload that can never load back
+            # (aot.fresh_compile) — publishing it would poison the
+            # warm start for every replica that follows
+            from raft_tpu.serving.aot import fresh_compile
+
+            with fresh_compile():
+                lowered = fn.lower(*args)
+                exe = lowered.compile()
+            # best-effort serialize for the next process; store never
+            # raises (an unserializable program just stays in-process)
+            self._aot.store(key, exe, lowered=lowered, args=tuple(args))
+        else:
+            lowered = fn.lower(*args)
+            exe = lowered.compile()
         with self._lock:
             # first compile wins a race; a precompile=False placeholder
             # (None) is filled, not treated as an existing executable
@@ -726,6 +857,18 @@ class RAFTEngine:
                 table[shape] = exe
                 cur = exe
         return cur
+
+    def aot_stats(self) -> Dict[str, int]:
+        """Serialized-cache counters for the bench summary line:
+        ``compiles_avoided`` == loads served without an XLA compile."""
+        with self._lock:
+            return {
+                "enabled": int(self._aot is not None),
+                "aot_hits": self.aot_hits,
+                "aot_misses": self.aot_misses,
+                "compiles": self.compile_count,
+                "compiles_avoided": self.aot_hits,
+            }
 
     def _select_bucket(self, b: int, h: int, w: int,
                        cached: bool = False

@@ -5,6 +5,14 @@
 equivalent is ``jax.export``: serialize the jitted serving function to
 StableHLO bytes that any XLA runtime (TPU/CPU/GPU) can reload and run,
 with symbolic batch/spatial dims for the dynamic axes.
+
+This is the PORTABILITY artifact — reloading it still pays a full XLA
+compile on the consumer. The zero-compile sibling is
+``raft_tpu/serving/aot.py``: the engine's serialized-EXECUTABLE cache
+(``jax.experimental.serialize_executable``), same-platform/same-version
+only, keyed on full provenance and audited by ``tools/graftexport``.
+Export ships programs across runtimes; the AOT cache ships compiled
+bytes across replicas.
 """
 
 from __future__ import annotations

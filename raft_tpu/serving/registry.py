@@ -302,12 +302,18 @@ class ModelRegistry:
                   warm_start: bool = False, wire: str = "f32",
                   exact_shapes: bool = False,
                   feature_cache: bool = False,
+                  artifact_dir: Optional[str] = None,
                   **sched_kw) -> None:
         """Register a model family; the first version goes straight
         live (``loading -> live``). ``engine=`` injects a prebuilt
         engine (drills share compiles across rounds); otherwise one is
         built from ``variables``/``config`` and precompiled over
-        ``envelope``. Extra kwargs reach the variant's scheduler."""
+        ``envelope``. ``artifact_dir=`` points the engine at a
+        serialized-executable cache (serving/aot.py): a replica
+        starting against a warm dir LOADS its envelope instead of
+        compiling it — the fleet-rollout compile storm becomes one
+        compile, N loads. Extra kwargs reach the variant's
+        scheduler."""
         with self._lock:
             if self._closed:
                 raise SchedulerClosed("registry is closed")
@@ -320,7 +326,8 @@ class ModelRegistry:
             iters=iters, envelope=envelope,
             engine_kw=dict(warm_start=warm_start, wire=wire,
                            exact_shapes=exact_shapes,
-                           feature_cache=feature_cache),
+                           feature_cache=feature_cache,
+                           aot_cache=artifact_dir),
             sched_kw=sched_kw, engine=engine)
         with self._lock:
             # re-checked at publish: the build ran outside the lock
@@ -347,6 +354,7 @@ class ModelRegistry:
                version: Optional[str] = None,
                iters: Optional[int] = None, envelope=None,
                engine: Optional[RAFTEngine] = None,
+               artifact_dir: Optional[str] = None,
                **sched_kw) -> str:
         """Roll out new weights (same arch) or a new arch for
         ``name`` as a canary serving ``canary_fraction`` of the
@@ -357,7 +365,12 @@ class ModelRegistry:
         auto-rollback — never under live traffic) defaulting to the
         live engine's bucket envelope and wire/warm-start recipe.
         ``promote()`` then reuses the live executables for a same-arch
-        canary via ``update_weights``."""
+        canary via ``update_weights``. ``artifact_dir=`` threads a
+        serialized-executable cache (serving/aot.py) into the canary
+        engine: a restarting supervisor re-deploying known weights
+        loads the canary envelope instead of recompiling it (keys are
+        weights-content addressed, so a genuinely NEW checkpoint still
+        compiles — and serializes for the replicas that follow)."""
         if not 0.0 < canary_fraction <= 1.0:
             raise ValueError(
                 f"canary_fraction={canary_fraction}: must be in (0, 1]")
@@ -394,7 +407,8 @@ class ModelRegistry:
                     exact_shapes=getattr(live.engine, "exact_shapes",
                                          False),
                     feature_cache=getattr(live.engine, "feature_cache",
-                                          False)),
+                                          False),
+                    aot_cache=artifact_dir),
                 sched_kw=sched_kw, engine=engine, same_arch=same_arch)
         except Exception as exc:
             # auto-rollback: nothing was routed, nothing is left. The

@@ -48,7 +48,13 @@ Plan entries (a list of dicts, or ``{"faults": [...]}``):
     survives and re-judges next tick) and a ``hang`` wedges the
     guardian thread with the canary still fully routed — the drilled
     contract is that a wedged guardian strands no futures and never
-    leaves a half-rolled canary).
+    leaves a half-rolled canary), ``aot.load`` (the serialized-
+    executable cache's verified load path, serving/aot.py —
+    ``kind="corrupt"`` smashes a file of the cache entry on disk
+    BEFORE the read (cached-artifact bit rot) and ``kind="raise"``
+    fails inside the verification scope; the drilled contract for BOTH
+    is a clean MISS-and-recompile — the engine never crashes, never
+    strands a future, and no corrupted artifact can serve traffic).
 ``at``
     1-based occurrence at which the entry becomes eligible (default 1).
     With the defaults below, each entry fires exactly once — the

@@ -1,0 +1,91 @@
+"""Worker for the cross-process AOT warm-start test (not a test module).
+
+Run twice against one ``--cache`` dir by tests/test_aot_cache.py: the
+cold leg compiles the bucket through ``RAFTEngine(aot_cache=...)`` and
+stores the serialized executable; the warm leg — a FRESH interpreter,
+the restarting-replica scenario serving/aot.py exists for — must load
+it back with ZERO XLA compiles (asserted via the engine's own compile
+counter, never timing: the jax persistent compile cache would make a
+timing pin lie) and produce bitwise-identical flow. Stats go to stdout
+as one ``AOT_WORKER {json}`` line; the flow goes to ``--out`` as .npy.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+import jax  # noqa: E402
+
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import json  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from raft_tpu.config import RAFTConfig  # noqa: E402
+from raft_tpu.models import RAFT  # noqa: E402
+from raft_tpu.serving.engine import RAFTEngine  # noqa: E402
+
+
+def main(cache_dir: str, out_npy: str, registry: bool = False) -> int:
+    cfg = RAFTConfig(small=True)
+    model = RAFT(cfg)
+    probe = jnp.zeros((1, 32, 32, 3))
+    # PRNGKey(0) init is deterministic across processes — both legs
+    # derive the SAME weights, hence the same content-addressed key
+    variables = model.init(jax.random.PRNGKey(0), probe, probe, iters=1)
+
+    if registry:
+        # the restarting-supervisor path: registry threads artifact_dir
+        # into the engines it builds; with a warm dir the live variant
+        # AND a re-deploy of known weights load instead of compiling
+        from raft_tpu.serving.registry import ModelRegistry
+
+        reg = ModelRegistry(gather_window_s=0.0)
+        try:
+            reg.add_model("m", variables, cfg, iters=1,
+                          envelope=[(1, 32, 32)], artifact_dir=cache_dir)
+            live = reg._models["m"].live.engine.aot_stats()
+            reg.deploy("m", variables, cfg, iters=1,
+                       envelope=[(1, 32, 32)], artifact_dir=cache_dir,
+                       canary_fraction=0.25)
+            canary = reg._models["m"].canary.engine.aot_stats()
+            reg.rollback("m")
+        finally:
+            reg.close()
+        print("AOT_WORKER " + json.dumps({"live": live,
+                                          "canary": canary}),
+              flush=True)
+        return 0
+
+    eng = RAFTEngine(variables, cfg, iters=1, envelope=[],
+                     precompile=False, aot_cache=cache_dir)
+    host = np.random.RandomState(7)
+    i1 = host.rand(1, 32, 32, 3).astype(np.float32) * 255
+    i2 = host.rand(1, 32, 32, 3).astype(np.float32) * 255
+    flow = np.asarray(eng.infer_batch(i1, i2))
+    np.save(out_npy, flow)
+    print("AOT_WORKER " + json.dumps(eng.aot_stats()), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--cache", required=True)
+    p.add_argument("--out", default="")
+    p.add_argument("--registry", action="store_true")
+    a = p.parse_args()
+    sys.exit(main(a.cache, a.out, registry=a.registry))

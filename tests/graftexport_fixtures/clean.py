@@ -1,0 +1,22 @@
+"""Negative control: a well-behaved exportable program.
+
+Complete key, a donation that survives the round trip (same-shaped
+in/out alias), no baked literals, no custom calls, honest platform,
+untampered signature, verified loader. Zero findings expected."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.graftexport import ExportTarget
+
+
+def _build():
+    def f(state, x):
+        return state + x, (x * 2.0).sum()
+
+    st = jax.ShapeDtypeStruct((64,), jnp.float32)
+    xs = jax.ShapeDtypeStruct((64,), jnp.float32)
+    return f, (st, xs), (0,)
+
+
+TARGETS = [ExportTarget(name="clean_fixture", build=_build, kind="fn")]

@@ -1,0 +1,24 @@
+"""E1 planted violation: an incomplete cache key.
+
+The written manifest omits ``weights`` and ``jaxlib`` — the two
+components whose absence bites hardest in production: a promoted model
+would collide with the old model's entry, and a runtime upgrade would
+load last release's blob. The production ``aot.store`` refuses this
+key; the fixture writes through the audit's low-level raw writer,
+modeling an older or third-party exporter."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.graftexport import ExportTarget
+
+
+def _build():
+    def f(x):
+        return x * 2.0 + 1.0
+
+    return f, (jax.ShapeDtypeStruct((32,), jnp.float32),), ()
+
+
+TARGETS = [ExportTarget(name="e1_fixture", build=_build, kind="fn",
+                        omit_key_fields=("weights", "jaxlib"))]

@@ -1,0 +1,27 @@
+"""E2 planted violation: a donation dropped by serialization.
+
+The live trace donates ``state`` (arg 0) onto a same-shaped output —
+XLA honors it, ``input_output_alias`` appears in the live optimized
+module. But the SERIALIZED blob comes from a non-donating compile of
+the same fn (``drop_donation_on_serialize``), modeling an export path
+that rebuilt the program without its alias map. A replica loading
+this artifact pays an input-sized copy per call that the compiling
+replica does not."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.graftexport import ExportTarget
+
+
+def _build():
+    def f(state, x):
+        return state + x, (x * x).sum()
+
+    st = jax.ShapeDtypeStruct((128,), jnp.float32)
+    xs = jax.ShapeDtypeStruct((128,), jnp.float32)
+    return f, (st, xs), (0,)
+
+
+TARGETS = [ExportTarget(name="e2_fixture", build=_build, kind="fn",
+                        drop_donation_on_serialize=True)]

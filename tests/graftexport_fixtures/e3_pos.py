@@ -1,0 +1,25 @@
+"""E3 planted violation: a weight matrix baked into the blob.
+
+``W`` is closure-captured instead of passed as an argument, so the
+trace carries it as a 2.25 MiB ``stablehlo.constant`` — over the
+1 MiB default budget. The cache key's weights fingerprint cannot see
+it: ``update_weights`` would swap the key while the OLD weights ride
+along inside the serialized program."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tools.graftexport import ExportTarget
+
+_W = np.arange(768 * 768, dtype=np.float32).reshape(768, 768) / 1e6
+
+
+def _build():
+    def f(x):
+        return x @ jnp.asarray(_W)
+
+    return f, (jax.ShapeDtypeStruct((4, 768), jnp.float32),), ()
+
+
+TARGETS = [ExportTarget(name="e3_fixture", build=_build, kind="fn")]

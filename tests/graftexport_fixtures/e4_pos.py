@@ -1,0 +1,44 @@
+"""E4 planted violations: non-portable artifacts, both flavors.
+
+``e4_callback``: a ``jax.pure_callback`` traced into the program — it
+lowers to a custom call holding a pointer into THIS process's Python
+heap; the blob cannot resolve it anywhere else (the production store
+tolerates the serialize failure; the artifact discipline does not
+tolerate the attempt).
+
+``e4_platform``: a clean program whose manifest CLAIMS platform
+"tpu" while the blob was compiled on CPU — the key would route the
+artifact to replicas whose backend never produced it."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tools.graftexport import ExportTarget
+
+
+def _build_callback():
+    def host_scale(x):
+        return np.asarray(x) * 2.0
+
+    def f(x):
+        y = jax.pure_callback(
+            host_scale,
+            jax.ShapeDtypeStruct((32,), jnp.float32), x)
+        return y + 1.0
+
+    return f, (jax.ShapeDtypeStruct((32,), jnp.float32),), ()
+
+
+def _build_platform():
+    def f(x):
+        return x * 3.0
+
+    return f, (jax.ShapeDtypeStruct((32,), jnp.float32),), ()
+
+
+TARGETS = [
+    ExportTarget(name="e4_callback", build=_build_callback, kind="fn"),
+    ExportTarget(name="e4_platform", build=_build_platform, kind="fn",
+                 platform_claim="tpu"),
+]

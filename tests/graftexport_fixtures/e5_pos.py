@@ -1,0 +1,25 @@
+"""E5 planted violation: calling-convention drift.
+
+The manifest's recorded signature is tampered after the write
+(``tamper_signature`` rewrites ``in[0]``), modeling a writer whose
+key was complete but whose recorded convention is wrong — a loading
+engine diffing the artifact against its live recipe must refuse to
+trust the blob's calling convention."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.graftexport import ExportTarget
+
+
+def _build():
+    def f(state, x):
+        return state + x, x - 1.0
+
+    st = jax.ShapeDtypeStruct((16,), jnp.float32)
+    xs = jax.ShapeDtypeStruct((16,), jnp.float32)
+    return f, (st, xs), ()
+
+
+TARGETS = [ExportTarget(name="e5_fixture", build=_build, kind="fn",
+                        tamper_signature=True)]

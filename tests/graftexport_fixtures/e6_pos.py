@@ -1,0 +1,26 @@
+"""E6 planted violation: a loader that skips the integrity checks.
+
+``naive_loader`` probes a manifest-ignoring load path (read blob,
+unpickle, deserialize — nothing else) with the manifest-level tampers:
+a torn manifest, a jax-version skew, a swapped weights key. The naive
+loader survives all three — each survival is a finding, the
+counterfactual showing exactly what the verified path's checks
+protect against. (Bit-level blob damage is never fed to the naive
+loader: unpickling corrupted bytes can kill the process, which is
+itself why the verified path hashes before it unpickles.)"""
+
+import jax
+import jax.numpy as jnp
+
+from tools.graftexport import ExportTarget
+
+
+def _build():
+    def f(x):
+        return x * x + 1.0
+
+    return f, (jax.ShapeDtypeStruct((32,), jnp.float32),), ()
+
+
+TARGETS = [ExportTarget(name="e6_fixture", build=_build, kind="fn",
+                        naive_loader=True)]

@@ -1,0 +1,421 @@
+"""Serialized-executable cache (serving/aot.py): the zero-compile
+warm-start seam and its trust model.
+
+Every pin here uses the engine's/cache's OWN counters, never timing —
+conftest enables the jax persistent compile cache, so a "fast second
+compile" proves nothing. ``compiles == 0`` + ``aot_hits == 1`` is the
+claim serving/aot.py makes; bitwise-equal flow is what makes a loaded
+executable interchangeable with a compiled one. The other half of the
+suite is the trust model: every corruption/skew/stale-key shape must
+read as a clean MISS (load returns None, caller recompiles) — no
+failure mode may load a wrong executable or raise into serving.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import RAFT
+from raft_tpu.serving import aot
+from raft_tpu.serving.engine import RAFTEngine
+from raft_tpu.serving.registry import ModelRegistry
+from raft_tpu.testing import faults
+
+from tests.conftest import mesh_subprocess_env
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(scope="module")
+def small_setup():
+    cfg = RAFTConfig(small=True)
+    model = RAFT(cfg)
+    img = jnp.zeros((1, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+    return cfg, variables
+
+
+@pytest.fixture
+def images(rng):
+    i1 = rng.rand(1, 32, 32, 3).astype(np.float32) * 255
+    i2 = rng.rand(1, 32, 32, 3).astype(np.float32) * 255
+    return i1, i2
+
+
+def _full_key(**overrides):
+    """A complete 12-field key for direct-AOTCache tests."""
+    key = {
+        "format": aot.AOT_FORMAT,
+        "program": "test",
+        "weights": "w" * 16,
+        "geometry": [1, 8],
+        "wire": "f32",
+        "iters": 1,
+        "config": "c" * 16,
+        "donations": [],
+        "partition": "single",
+        "jax": jax.__version__,
+        "jaxlib": __import__("jaxlib").__version__,
+        "platform": jax.default_backend(),
+    }
+    key.update(overrides)
+    return key
+
+
+def _store_tiny(root):
+    """Compile + store a tiny program; returns (cache, key, entry_dir,
+    example input). fresh_compile: conftest enables jax's persistent
+    compile cache, and a cache-deserialized executable serializes to a
+    stillborn payload — the exact hazard aot.fresh_compile exists for."""
+    fn = jax.jit(lambda x: x * 2.0 + 1.0)
+    x = jnp.arange(8, dtype=jnp.float32)
+    with aot.fresh_compile():
+        lowered = fn.lower(x)
+        compiled = lowered.compile()
+    cache = aot.AOTCache(root)
+    key = _full_key()
+    edir = cache.store(key, compiled, lowered=lowered, args=(x,))
+    assert edir is not None
+    return cache, key, edir, x
+
+
+# -- the engine seam ------------------------------------------------------
+
+
+class TestEngineWarmStart:
+    def test_in_process_warm_start_zero_compiles_bitwise(
+            self, small_setup, images, tmp_path):
+        cfg, variables = small_setup
+        i1, i2 = images
+        eng1 = RAFTEngine(variables, cfg, iters=1, envelope=[],
+                          precompile=False, aot_cache=str(tmp_path))
+        out1 = np.asarray(eng1.infer_batch(i1, i2))
+        s1 = eng1.aot_stats()
+        assert s1["enabled"] == 1
+        assert s1["compiles"] == 1 and s1["aot_misses"] == 1
+        assert s1["aot_hits"] == 0
+
+        # a second engine over the same dir = the restarted replica.
+        # aot.fresh_compile made eng1's artifact a first-generation
+        # payload, so this load is deterministic even in-process.
+        eng2 = RAFTEngine(variables, cfg, iters=1, envelope=[],
+                          precompile=False, aot_cache=str(tmp_path))
+        out2 = np.asarray(eng2.infer_batch(i1, i2))
+        s2 = eng2.aot_stats()
+        assert s2["compiles"] == 0, (s2, eng2._aot.last_miss)
+        assert s2["aot_hits"] == 1 and s2["compiles_avoided"] == 1
+        assert np.array_equal(out1, out2)   # bitwise, not allclose
+
+    def test_weights_swap_invalidates_then_old_artifact_rehits(
+            self, small_setup, tmp_path):
+        cfg, variables = small_setup
+        eng = RAFTEngine(variables, cfg, iters=1, envelope=[],
+                         precompile=False, aot_cache=str(tmp_path))
+        bucket = eng.ensure_bucket(1, 32, 32)
+        assert eng.aot_stats()["compiles"] == 1
+
+        # same structure/shapes, different content: a genuinely new
+        # checkpoint must MISS (content-addressed key), never load the
+        # old model's artifact
+        swapped = jax.tree_util.tree_map(lambda a: a + 1e-3, variables)
+        eng.update_weights(swapped)
+        assert eng.drop_bucket(bucket)
+        eng.ensure_bucket(1, 32, 32)
+        s = eng.aot_stats()
+        assert s["compiles"] == 2 and s["aot_misses"] == 2
+        entries = os.listdir(os.path.join(str(tmp_path), "objects"))
+        assert len(entries) == 2    # two checkpoints, two artifacts
+
+        # swapping BACK re-keys to the first artifact: the old
+        # checkpoint's entry is reachable again (content addressing —
+        # pinned at the key/manifest level: an in-process reload of a
+        # program whose identical twin was ALREADY compiled here trips
+        # a CPU-backend symbol-registry quirk, a sequence the real
+        # engine never runs — a twin in the bucket table means no AOT
+        # load happens at all; cross-process reload is the test above)
+        eng.update_weights(variables)
+        key_back = eng._aot_key(bucket)
+        edir = eng._aot.entry_dir(key_back)
+        assert os.path.isdir(edir)
+        with open(os.path.join(edir, "manifest.json"),
+                  encoding="utf-8") as f:
+            assert json.load(f)["key"] == key_back
+
+    def test_cross_process_warm_start(self, tmp_path):
+        """The scenario the cache exists for: a fresh interpreter loads
+        the artifact a previous process compiled — zero compiles,
+        bitwise-identical flow."""
+        worker = os.path.join(_HERE, "aot_warm_worker.py")
+        cache = str(tmp_path / "artifacts")
+        env = mesh_subprocess_env(local_devices=1)
+        stats, outs = [], []
+        for leg in ("cold", "warm"):
+            out_npy = str(tmp_path / f"{leg}.npy")
+            proc = subprocess.run(
+                [sys.executable, worker, "--cache", cache,
+                 "--out", out_npy],
+                capture_output=True, text=True, env=env, timeout=600)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            line = [ln for ln in proc.stdout.splitlines()
+                    if ln.startswith("AOT_WORKER ")]
+            assert line, proc.stdout
+            stats.append(json.loads(line[-1][len("AOT_WORKER "):]))
+            outs.append(np.load(out_npy))
+        cold, warm = stats
+        assert cold["compiles"] == 1 and cold["aot_misses"] == 1
+        assert warm["compiles"] == 0, warm
+        assert warm["aot_hits"] == 1 and warm["compiles_avoided"] == 1
+        assert np.array_equal(outs[0], outs[1])
+
+    def test_disabled_engine_reports_disabled(self, small_setup):
+        cfg, variables = small_setup
+        eng = RAFTEngine(variables, cfg, iters=1, envelope=[],
+                         precompile=False)
+        s = eng.aot_stats()
+        assert s["enabled"] == 0
+        assert s["aot_hits"] == 0 and s["aot_misses"] == 0
+
+
+# -- the trust model (direct AOTCache) ------------------------------------
+
+
+class TestVerifiedLoad:
+    def test_roundtrip_hits_and_runs(self, tmp_path):
+        """Store here, load in a FRESH interpreter (deterministic —
+        in-process reloads roll the CPU twin-symbol dice) and run."""
+        _, key, _, x = _store_tiny(str(tmp_path))
+        prog = (
+            "import sys, json\n"
+            f"sys.path.insert(0, {os.path.dirname(_HERE)!r})\n"
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import jax, jax.numpy as jnp, numpy as np\n"
+            "try:\n"
+            "    from jax._src import xla_bridge as _xb\n"
+            "    _xb._backend_factories.pop('axon', None)\n"
+            "except Exception: pass\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from raft_tpu.serving import aot\n"
+            "cache = aot.AOTCache(sys.argv[1])\n"
+            "key = json.loads(sys.argv[2])\n"
+            "runner = cache.load(key)\n"
+            "assert runner is not None, cache.last_miss\n"
+            "out = np.asarray(runner(jnp.arange(8, dtype=jnp.float32)))\n"
+            "assert np.array_equal(out, np.arange(8) * 2.0 + 1.0), out\n"
+            "print('ROUNDTRIP OK')\n")
+        proc = subprocess.run(
+            [sys.executable, "-c", prog, str(tmp_path),
+             json.dumps(key)],
+            capture_output=True, text=True,
+            env=mesh_subprocess_env(local_devices=1), timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "ROUNDTRIP OK" in proc.stdout
+
+    @pytest.mark.parametrize("tamper,reason", [
+        ("blob-truncate", "blob hash mismatch"),
+        ("blob-bit-flip", "blob hash mismatch"),
+        ("manifest-torn", "JSONDecodeError"),
+        ("manifest-version-skew", "format skew"),
+        ("manifest-key-swap", "key mismatch"),
+    ])
+    def test_corruption_routes_to_miss(self, tmp_path, tamper, reason):
+        """Every damage shape reads as a clean miss with the RIGHT
+        diagnosis — and the pristine entry still loads afterwards."""
+        _, key, edir, _ = _store_tiny(str(tmp_path))
+        backup = str(tmp_path / "backup")
+        shutil.copytree(edir, backup)
+        blob = os.path.join(edir, "executable.bin")
+        manifest = os.path.join(edir, "manifest.json")
+        if tamper == "blob-truncate":
+            with open(blob, "rb") as f:
+                data = f.read()
+            with open(blob, "wb") as f:
+                f.write(data[:len(data) // 2])
+        elif tamper == "blob-bit-flip":
+            with open(blob, "rb") as f:
+                data = bytearray(f.read())
+            data[len(data) // 2] ^= 0x40
+            with open(blob, "wb") as f:
+                f.write(bytes(data))
+        elif tamper == "manifest-torn":
+            with open(manifest, encoding="utf-8") as f:
+                text = f.read()
+            with open(manifest, "w", encoding="utf-8") as f:
+                f.write(text[:len(text) // 2])
+        elif tamper == "manifest-version-skew":
+            with open(manifest, encoding="utf-8") as f:
+                m = json.load(f)
+            m["format"] = "jax_serialize_executable_v0"
+            with open(manifest, "w", encoding="utf-8") as f:
+                json.dump(m, f)
+        elif tamper == "manifest-key-swap":
+            with open(manifest, encoding="utf-8") as f:
+                m = json.load(f)
+            m["key"] = dict(m["key"], weights="f" * 16)
+            with open(manifest, "w", encoding="utf-8") as f:
+                json.dump(m, f)
+        fresh = aot.AOTCache(str(tmp_path))
+        assert fresh.load(key) is None
+        assert reason in fresh.last_miss, fresh.last_miss
+        # restore: the pristine bytes verify again. Checked at the
+        # manifest/hash layer, not via a full deserialize — repeated
+        # in-process deserializes of twin programs trip a CPU-backend
+        # symbol-registry quirk (fresh-process loads, the real
+        # scenario, are pinned by test_cross_process_warm_start)
+        shutil.rmtree(edir)
+        shutil.copytree(backup, edir)
+        assert fresh._entry_valid(edir, key)
+
+    def test_stale_key_is_absent_miss(self, tmp_path):
+        cache, key, _, _ = _store_tiny(str(tmp_path))
+        fresh = aot.AOTCache(str(tmp_path))
+        assert fresh.load(dict(key, weights="f" * 16)) is None
+        assert fresh.last_miss == "absent"
+
+    def test_store_refuses_incomplete_key(self, tmp_path):
+        fn = jax.jit(lambda x: x + 1.0)
+        x = jnp.zeros(4)
+        compiled = fn.lower(x).compile()
+        key = _full_key()
+        del key["weights"]
+        with pytest.raises(ValueError, match="weights"):
+            aot.AOTCache(str(tmp_path)).store(key, compiled)
+
+    def test_unserializable_program_stores_none(self, tmp_path):
+        """Host-callback programs can't serialize; store must decline
+        (None), never raise — the cache accelerates, it never gates."""
+        def fn(x):
+            return jax.pure_callback(
+                lambda v: np.asarray(v) * 2.0,  # graftlint: disable=R1
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+        x = jnp.zeros(4, jnp.float32)
+        compiled = jax.jit(fn).lower(x).compile()
+        assert aot.AOTCache(str(tmp_path)).store(_full_key(),
+                                                 compiled) is None
+
+    def test_store_replaces_invalid_entry(self, tmp_path):
+        _, key, edir, x = _store_tiny(str(tmp_path))
+        with open(os.path.join(edir, "executable.bin"), "wb") as f:
+            f.write(b"\0" * 64)
+        cache2, _, edir2, _ = _store_tiny(str(tmp_path))
+        assert edir2 == edir
+        assert aot.AOTCache(str(tmp_path))._entry_valid(edir, key)
+
+
+# -- the chaos surface ----------------------------------------------------
+
+
+class TestFaultSite:
+    def test_fault_point_raise_reads_as_miss(self, tmp_path):
+        _, key, _, _ = _store_tiny(str(tmp_path))
+        cache = aot.AOTCache(str(tmp_path))
+        faults.arm([{"site": "aot.load", "kind": "raise"}])
+        try:
+            assert cache.load(key) is None
+            assert "FaultInjected" in cache.last_miss
+        finally:
+            faults.disarm()
+        # disarmed: the entry itself was never damaged
+        assert cache._entry_valid(cache.entry_dir(key), key)
+
+    def test_fault_file_corrupt_reads_as_miss(self, tmp_path):
+        _, key, _, _ = _store_tiny(str(tmp_path))
+        cache = aot.AOTCache(str(tmp_path))
+        faults.arm([{"site": "aot.load", "kind": "corrupt",
+                     "at": 1, "count": 1}])
+        try:
+            assert cache.load(key) is None
+            assert cache.last_miss == "blob hash mismatch"
+        finally:
+            faults.disarm()
+
+    def test_engine_recompiles_cleanly_through_corrupt_artifact(
+            self, small_setup, images, tmp_path):
+        """The chaos-drill round in miniature: a corrupted artifact
+        mid-run reads as miss, the engine recompiles, and the
+        re-stored entry is valid again."""
+        cfg, variables = small_setup
+        i1, i2 = images
+        eng = RAFTEngine(variables, cfg, iters=1, envelope=[],
+                         precompile=False, aot_cache=str(tmp_path))
+        out1 = np.asarray(eng.infer_batch(i1, i2))
+        bucket = eng.ensure_bucket(1, 32, 32)
+        assert eng.drop_bucket(bucket)
+        faults.arm([{"site": "aot.load", "kind": "corrupt",
+                     "at": 1, "count": 1}])
+        try:
+            out2 = np.asarray(eng.infer_batch(i1, i2))
+        finally:
+            faults.disarm()
+        s = eng.aot_stats()
+        assert s["compiles"] == 2 and s["aot_misses"] == 2, s
+        assert np.array_equal(out1, out2)
+        # the recompile RE-STORED over the corrupted entry: the digest
+        # verifies again (a fresh replica loads it — the cross-process
+        # test pins that path; an in-process reload would roll the
+        # CPU-backend twin-symbol dice, see TestVerifiedLoad)
+        key = eng._aot_key(bucket)
+        assert eng._aot._entry_valid(eng._aot.entry_dir(key), key)
+
+
+# -- the registry seam ----------------------------------------------------
+
+
+class TestRegistryArtifactDir:
+    def test_add_model_threads_artifact_dir(self, small_setup,
+                                            tmp_path):
+        """The wiring: ``artifact_dir=`` arms the engine the registry
+        builds (zero-compile proof is the cross-process test below —
+        in-process reloads roll the CPU twin-symbol dice)."""
+        cfg, variables = small_setup
+        adir = str(tmp_path / "artifacts")
+        reg = ModelRegistry(gather_window_s=0.0)
+        try:
+            reg.add_model("m", variables, cfg, iters=1,
+                          envelope=[(1, 32, 32)], artifact_dir=adir)
+            live = reg._models["m"].live.engine
+            s = live.aot_stats()
+            assert s["enabled"] == 1
+            assert live._aot.root == os.path.abspath(adir)
+            # precompiling the envelope published the artifact
+            assert len(os.listdir(os.path.join(adir, "objects"))) == 1
+        finally:
+            reg.close()
+
+    @pytest.mark.slow
+    def test_registry_cross_process_warm_start(self, tmp_path):
+        """The restarting supervisor: a fresh process re-registers the
+        same checkpoint against a warm dir — the live variant AND a
+        re-deploy of known weights load with zero compiles."""
+        worker = os.path.join(_HERE, "aot_warm_worker.py")
+        cache = str(tmp_path / "artifacts")
+        env = mesh_subprocess_env(local_devices=1)
+
+        cold = subprocess.run(
+            [sys.executable, worker, "--cache", cache,
+             "--out", str(tmp_path / "cold.npy")],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert cold.returncode == 0, cold.stderr[-2000:]
+
+        warm = subprocess.run(
+            [sys.executable, worker, "--cache", cache, "--registry"],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert warm.returncode == 0, warm.stderr[-2000:]
+        line = [ln for ln in warm.stdout.splitlines()
+                if ln.startswith("AOT_WORKER ")]
+        assert line, warm.stdout
+        stats = json.loads(line[-1][len("AOT_WORKER "):])
+        assert stats["live"]["compiles"] == 0, stats
+        assert stats["live"]["aot_hits"] >= 1
+        assert stats["canary"]["compiles"] == 0, stats
+        assert stats["canary"]["aot_hits"] >= 1

@@ -1,0 +1,275 @@
+"""graftexport: the serialized-executable audit gate (tools/graftexport/).
+
+Three layers, mirroring the sibling tier tests:
+
+- per-rule fixture tests: each rule E1-E6 has a fixture program under
+  ``tests/graftexport_fixtures/`` with a PLANTED violation (a manifest
+  missing the weights/jaxlib key components, a serialization path that
+  drops the donation alias map, a closure-captured multi-MB weight
+  literal, a host callback + a dishonest platform claim, a tampered
+  signature block, a naive loader that survives corruption) —
+  detection must fire, and both suppression channels (a Waiver on the
+  target; a baseline entry) must round-trip;
+- mechanism tests: waiver-justification enforcement, the lintcache-
+  backed warm cache, stale-baseline failure, CLI usage errors, and the
+  REQUIRED_KEY_FIELDS mirror pin (the jax-free literal in spec.py must
+  equal the live set in serving/aot.py — the warm path answers without
+  importing either);
+- the repo gate: ``python -m tools.graftexport --json`` over the REAL
+  serve programs (plain f32, u8 warm-start, feature-cache, ragged)
+  round-tripped through the production AOTCache must exit 0 with no
+  findings, the committed baseline must stay EMPTY, and the warm gate
+  must answer in under 45 s WITHOUT importing jax (pinned with a
+  poisoned ``jax`` shim on PYTHONPATH).
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "graftexport_fixtures")
+BASELINE = os.path.join(REPO, "tools", "graftexport", "baseline.json")
+
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tests.conftest import mesh_subprocess_env  # noqa: E402
+from tools.graftexport import (ExportTarget, Waiver,  # noqa: E402
+                               apply_baseline, audit_targets,
+                               load_baseline, load_fixture_targets,
+                               write_baseline)
+from tools.graftexport.core import cached_audit, main  # noqa: E402
+
+RULES = ("E1", "E2", "E3", "E4", "E5", "E6")
+
+_AUDIT_CACHE = {}
+
+
+def fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+def audit_fixture(name):
+    """(targets, findings) for one fixture module, audited once per
+    test session — detection, waiver, and baseline tests all read the
+    same run (each audit is a real compile + serialize round trip)."""
+    if name not in _AUDIT_CACHE:
+        targets = load_fixture_targets(fixture(name))
+        findings, _ = audit_targets(targets)
+        _AUDIT_CACHE[name] = (targets, findings)
+    return _AUDIT_CACHE[name]
+
+
+class TestRuleFixtures:
+    @pytest.mark.parametrize("rule", RULES)
+    def test_planted_violation_detected(self, rule):
+        _, findings = audit_fixture(f"{rule.lower()}_pos.py")
+        assert any(f.rule == rule for f in findings), \
+            f"{rule} fixture produced no {rule} finding: {findings}"
+
+    @pytest.mark.parametrize("rule", RULES)
+    def test_waiver_suppresses_with_justification(self, rule):
+        """The pragma analog: a Waiver(rule, detail-substring, reason)
+        on the target declaration silences exactly that finding."""
+        targets, findings = audit_fixture(f"{rule.lower()}_pos.py")
+        details = [f.detail for f in findings if f.rule == rule]
+        assert details
+        waived_targets = [
+            dataclasses.replace(
+                t, waivers=t.waivers + tuple(
+                    Waiver(rule, d, "fixture round-trip")
+                    for d in details))
+            for t in targets]
+        refindings, _ = audit_targets(waived_targets)
+        assert not any(f.rule == rule for f in refindings), \
+            f"waiver did not suppress: {refindings}"
+        # a waiver naming a DIFFERENT rule must not suppress
+        wrong = "E1" if rule != "E1" else "E2"
+        wrong_targets = [
+            dataclasses.replace(
+                t, waivers=tuple(Waiver(wrong, d, "wrong rule")
+                                 for d in details))
+            for t in targets]
+        refindings, _ = audit_targets(wrong_targets)
+        assert any(f.rule == rule for f in refindings)
+
+    @pytest.mark.parametrize("rule", RULES)
+    def test_baseline_roundtrip_then_stale(self, rule, tmp_path):
+        """Grandfathering consumes the entry; a fixed finding leaves a
+        STALE entry that must fail (it would otherwise silently
+        grandfather the next reintroduction)."""
+        targets, findings = audit_fixture(f"{rule.lower()}_pos.py")
+        bl = tmp_path / "baseline.json"
+        write_baseline(str(bl), findings)
+        new, stale = apply_baseline(findings, load_baseline(str(bl)))
+        assert new == [] and stale == []
+        # "fixed": nothing found, every entry unconsumed -> stale
+        new, stale = apply_baseline(
+            [], load_baseline(str(bl)),
+            audited_targets=[t.name for t in targets])
+        assert new == [] and len(stale) == len(findings)
+        # an entry for a target OUTSIDE this run is merely unchecked
+        new, stale = apply_baseline(
+            [], load_baseline(str(bl)),
+            audited_targets=["some_other_target"])
+        assert new == [] and stale == []
+
+    def test_clean_fixture_is_silent(self):
+        """The negative: a complete key, donations that survive the
+        round trip, small literals, portable custom calls, a matching
+        signature, every probe routed to miss — all rules silent."""
+        _, findings = audit_fixture("clean.py")
+        assert findings == [], \
+            "; ".join(f.render() for f in findings)
+
+
+class TestMechanisms:
+    def test_waiver_requires_justification(self):
+        with pytest.raises(ValueError, match="justification"):
+            Waiver("E4", "anything", "   ")
+
+    def test_cached_audit_hits_and_matches(self, tmp_path):
+        """Second run through the lintcache file must serve from cache
+        (no rebuild) and return identical findings."""
+        targets = load_fixture_targets(fixture("e1_pos.py"))
+        from tools.graftexport.rules import ALL_RULES
+        path = str(tmp_path / "cache.json")
+        f1, _, hits1 = cached_audit(targets, ALL_RULES, path)
+        assert hits1 == {"e1_fixture": False}
+        f2, _, hits2 = cached_audit(targets, ALL_RULES, path)
+        assert hits2 == {"e1_fixture": True}
+        assert [f.key() for f in f2] == [f.key() for f in f1]
+        # a different rule set is a different key: no false hit
+        donation_only = [m for m in ALL_RULES if m.RULE == "E2"]
+        f3, _, hits3 = cached_audit(targets, donation_only, path)
+        assert hits3 == {"e1_fixture": False}
+        assert f3 == []     # E2 alone can't see the key omission
+
+    def test_required_key_fields_mirror_the_live_store(self):
+        """targets/spec carry a jax-free literal MIRROR of the store's
+        required key set (the warm cache path must not import jax OR
+        raft_tpu); this pin is what makes the mirror safe — drift
+        between the literal and ``aot.REQUIRED_KEY_FIELDS`` fails here
+        before the gate can desynchronize from the store it audits."""
+        from raft_tpu.serving import aot
+        from tools.graftexport import spec
+        assert spec.REQUIRED_KEY_FIELDS == aot.REQUIRED_KEY_FIELDS
+
+    def test_cli_usage_errors(self, tmp_path):
+        assert main(["--rules", "E9"]) == 2
+        assert main(["--rules", "E1", "--write-baseline",
+                     str(tmp_path / "b.json")]) == 2
+        assert main(["--fixture",
+                     str(tmp_path / "missing.py")]) == 2
+        broken = tmp_path / "broken_fixture.py"
+        broken.write_text("import no_such_module_xyz\n")
+        assert main(["--fixture", str(broken)]) == 2
+
+    def test_cli_fixture_json_and_baseline_flow(self, tmp_path, capsys):
+        """CLI end-to-end on the cheapest fixture: findings as JSON,
+        then grandfathered via --write-baseline, then unchecked (not
+        stale) for a run over different targets."""
+        rc = main(["--fixture", fixture("e1_pos.py"), "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert any(f["rule"] == "E1" for f in out)
+        assert all({"target", "rule", "name", "detail", "message"}
+                   <= set(f) for f in out)
+        bl = tmp_path / "bl.json"
+        rc = main(["--fixture", fixture("e1_pos.py"),
+                   "--write-baseline", str(bl)])
+        assert rc == 0 and bl.exists()
+        capsys.readouterr()
+        rc = main(["--fixture", fixture("e1_pos.py"),
+                   "--baseline", str(bl)])
+        assert rc == 0        # grandfathered
+        rc = main(["--fixture", fixture("clean.py"),
+                   "--baseline", str(bl)])
+        capsys.readouterr()
+        assert rc == 0        # different targets: unchecked, not stale
+
+
+class TestRepoGate:
+    """The actual gate: the real serve artifacts must audit clean."""
+
+    def _run_gate(self, cache_dir, pythonpath_prefix=""):
+        env = mesh_subprocess_env(
+            local_devices=1,
+            extra_env={"RAFT_GRAFTEXPORT_CACHE":
+                       os.path.join(cache_dir, "cache.json")})
+        if pythonpath_prefix:
+            env["PYTHONPATH"] = pythonpath_prefix + os.pathsep + \
+                env.get("PYTHONPATH", "")
+        return subprocess.run(
+            [sys.executable, "-m", "tools.graftexport", "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=600,
+            env=env)
+
+    def test_repo_audit_clean_and_warm_without_jax(self, tmp_path):
+        """Cold run round-trips the four serve programs through the
+        production AOTCache and must gate clean; the SECOND run
+        answers from the lintcache entry keyed on the raft_tpu source
+        hash + rule set — pinned under the 45 s warm budget AND proven
+        jax-free by a poisoned ``jax`` shim on PYTHONPATH (importing
+        it raises, so a warm path that touched jax would crash)."""
+        r = self._run_gate(str(tmp_path))
+        assert r.returncode == 0, \
+            f"graftexport findings:\n{r.stdout}\n{r.stderr}"
+        assert json.loads(r.stdout) == []
+        poison = tmp_path / "poison"
+        poison.mkdir()
+        (poison / "jax.py").write_text(
+            "raise ImportError('graftexport warm path imported jax')\n")
+        t0 = time.monotonic()
+        r2 = self._run_gate(str(tmp_path),
+                            pythonpath_prefix=str(poison))
+        warm_s = time.monotonic() - t0
+        assert r2.returncode == 0, \
+            f"warm gate failed:\n{r2.stdout}\n{r2.stderr}"
+        assert json.loads(r2.stdout) == []
+        assert "cache" in r2.stderr, r2.stderr
+        assert warm_s < 45, f"warm gate took {warm_s:.1f}s"
+
+    def test_baseline_stays_empty(self):
+        """The first scan's findings were FIXED at the site — aot.py
+        grew the key-completeness refusal and the manifest/hash
+        verification the load path now routes through — never
+        grandfathered. The baseline ships EMPTY and stays that way:
+        new findings are fixed or waived with justification."""
+        with open(BASELINE) as f:
+            entries = json.load(f)["findings"]
+        assert entries == [], (
+            "graftexport baseline regrew — fix or waive the finding "
+            f"instead of grandfathering it: {entries}")
+
+    def test_targets_mirror_the_engine_program_table(self):
+        """The audited targets must cover the REAL program table the
+        engine serves from — one target per serve recipe (plain f32,
+        u8 warm-start, feature-cache, ragged), each built through
+        ``RAFTEngine(aot_cache=...)`` so the audited entry is written
+        by the production store path, not a test stand-in."""
+        from tools.graftexport.targets import export_targets
+        targets = {t.name: t for t in export_targets()}
+        assert set(targets) == {"serve", "serve_u8_warm",
+                                "serve_cached", "serve_ragged"}
+        assert all(t.kind == "engine" for t in targets.values())
+
+    def test_meta_gate_runs_five_tiers(self):
+        """``python -m tools.graft`` fans out over FIVE tiers now —
+        the fifth is this one. Pinned against the tier table (the full
+        five-tier run is the pre-commit command; the expensive tiers
+        have their own gate tests)."""
+        from tools.graft import TIER_ARGS, TIERS
+        assert "graftexport" in TIER_ARGS
+        assert len(TIERS) == 5
+        # usage errors stay usage errors
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graft", "--tiers", "nope"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert r.returncode == 2

@@ -1,10 +1,11 @@
 """graft: the one-command static-analysis meta-gate.
 
-Runs all four tiers — graftlint (source), graftaudit (single-device
+Runs all five tiers — graftlint (source), graftaudit (single-device
 compiled artifacts), graftthread (thread-safety declarations),
-graftshard (partitioned programs on the forced multi-device CPU mesh)
-— and merges their machine-readable output into one JSON summary with
-one exit code. This is the pre-commit check::
+graftshard (partitioned programs on the forced multi-device CPU mesh),
+graftexport (serialized executables round-tripped through the AOT
+artifact cache) — and merges their machine-readable output into one
+JSON summary with one exit code. This is the pre-commit check::
 
     python -m tools.graft --json
 
@@ -13,10 +14,11 @@ findings are in the summary), 2 usage error or a tier that failed to
 run at all. Each tier runs in its own subprocess: the tiers disagree
 about interpreter state on purpose (graftlint/graftthread must never
 import jax; graftshard must configure the virtual mesh BEFORE jax
-initializes), and isolation keeps each tier's contract intact.
+initializes; graftexport pins the single-device CPU backend), and
+isolation keeps each tier's contract intact.
 
 ``--tiers a,b`` runs a subset (the test gate uses the stdlib tiers to
-stay fast; CI and pre-commit run all four).
+stay fast; CI and pre-commit run all five).
 """
 
 from __future__ import annotations
@@ -45,6 +47,7 @@ TIER_ARGS = {
     "graftaudit": [],
     "graftthread": [],
     "graftshard": [],
+    "graftexport": [],
 }
 TIERS = tuple(TIER_ARGS)
 
@@ -78,10 +81,10 @@ def run_tier(name: str) -> dict:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="graft",
-        description="Run all four static-analysis tiers (graftlint, "
-                    "graftaudit, graftthread, graftshard) with one "
-                    "merged JSON summary and one exit code — the "
-                    "pre-commit gate.")
+        description="Run all five static-analysis tiers (graftlint, "
+                    "graftaudit, graftthread, graftshard, graftexport) "
+                    "with one merged JSON summary and one exit code — "
+                    "the pre-commit gate.")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable merged summary")
     p.add_argument("--tiers", metavar="T1,T2",

@@ -1,0 +1,38 @@
+"""graftexport: the serialized-executable static-analysis tier.
+
+Fifth tier of the gate family — graftlint reads source, graftaudit
+reads single-device compiled artifacts, graftthread reads
+thread-safety declarations, graftshard reads partitioned programs,
+graftexport reads SERIALIZED ARTIFACTS: the real serve programs
+(plain f32, u8 warm-start, feature-cache, ragged) round-tripped
+through the AOT executable cache (``raft_tpu/serving/aot.py``,
+``jax.experimental.serialize_executable``) and audited on BOTH sides
+of the disk boundary against rules E1–E6, each a concrete
+cached-artifact bug class:
+
+- E1 ``incomplete-cache-key``: a manifest key missing/empty a
+  required provenance component — the stale-load hazard;
+- E2 ``donation-dropped-by-serialization``: ``input_output_alias``
+  entries present in the live compile but absent from the
+  deserialized executable;
+- E3 ``baked-weight-literal``: multi-MB constants serialized into the
+  blob — weights belong in arguments, keyed by fingerprint;
+- E4 ``non-portable-artifact``: custom-call targets that pin the blob
+  to the writing process/platform; dishonest platform claims;
+- E5 ``calling-convention-drift``: manifest signature vs the loading
+  engine's live recipe;
+- E6 ``integrity-check-bypassed``: fault-injected corruption / skew /
+  stale-key probes that the load path SURVIVES instead of routing to
+  miss-and-recompile.
+
+Same surface as the siblings: ``python -m tools.graftexport --json``,
+shrink-only (and EMPTY) ``baseline.json``, per-finding ``Waiver`` with
+required justification, lintcache-backed warm repeats. The meta-gate
+``python -m tools.graft --json`` runs all five tiers.
+"""
+
+from .core import (apply_baseline, audit_targets,  # noqa: F401
+                   load_baseline, load_fixture_targets, main,
+                   write_baseline)
+from .finding import ExportFinding  # noqa: F401
+from .spec import ExportArtifacts, ExportTarget, Waiver  # noqa: F401

@@ -1,0 +1,350 @@
+"""Build export-audit artifacts: round-trip a program through the AOT
+serialize/load seam and record both halves.
+
+The cold path drives the REAL seam (``raft_tpu/serving/aot.py``): an
+engine target serializes through ``RAFTEngine._get_executable``'s own
+store, the entry is reloaded through the verified ``AOTCache.load``,
+and tampered COPIES of the entry are probed to prove every corruption
+routes to miss (E6). Fixture (``kind="fn"``) targets write through a
+low-level raw writer instead, so they can plant exactly the defect a
+rule exists to catch — the production store refuses most of them by
+construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from .spec import ExportArtifacts, ExportTarget
+
+_MANIFEST = "manifest.json"
+_BLOB = "executable.bin"
+
+
+def prepare_env() -> None:
+    """Env-only half of :func:`ensure_cpu`: pin the CPU backend before
+    jax is imported, WITHOUT importing jax. The driver calls this
+    before loading fixture modules (which, like the sibling tiers'
+    fixtures, import jax at module scope)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def ensure_cpu():
+    """Force the CPU backend exactly the way tests/conftest.py does:
+    the image's sitecustomize registers the 'axon' remote-TPU plugin in
+    every interpreter and jax would initialize it even under
+    JAX_PLATFORMS=cpu — an audit must never dial (or block on) the
+    tunnel. Safe to call when jax is already imported/configured."""
+    prepare_env()
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+# -- low-level entry IO (fixture writers + E6 probes) ---------------------
+
+def _write_entry_raw(root: str, components: Dict, compiled, lowered,
+                     args, *, platform_claim: str = "",
+                     tamper_signature: bool = False) -> str:
+    """Write one cache entry WITHOUT the production store's key
+    completeness check — the fixture stand-in for an older or
+    third-party writer. Layout and manifest shape are byte-compatible
+    with ``aot.store`` so the verified loader (and the rules) read
+    both the same way."""
+    from raft_tpu.serving import aot
+    from jax.experimental import serialize_executable as se
+
+    payload, in_tree, out_tree = se.serialize(compiled)
+    blob = pickle.dumps((payload, in_tree, out_tree),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    if platform_claim:
+        components = dict(components, platform=platform_claim)
+    signature = aot.build_signature(args, lowered)
+    if tamper_signature and signature.get("in"):
+        signature["in"] = ["tampered[0]"] + signature["in"][1:]
+    manifest = {
+        "format": aot.AOT_FORMAT,
+        "key": components,
+        "sha256": hashlib.sha256(blob).hexdigest(),
+        "blob_bytes": len(blob),
+        "signature": signature,
+    }
+    edir = os.path.join(root, "objects", aot.key_digest(components))
+    os.makedirs(edir, exist_ok=True)
+    with open(os.path.join(edir, _BLOB), "wb") as f:
+        f.write(blob)
+    with open(os.path.join(edir, _MANIFEST), "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    return edir
+
+
+def _naive_load(edir: str):
+    """The E6 counterfactual: a loader that skips every manifest check
+    — reads the blob and deserializes it, nothing else. A probe run
+    through THIS loader shows what each integrity check is protecting
+    against; the production path (``aot.AOTCache.load``) must never
+    behave like it."""
+    from jax.experimental import serialize_executable as se
+
+    with open(os.path.join(edir, _BLOB), "rb") as f:
+        payload, in_tree, out_tree = pickle.loads(f.read())
+    return se.deserialize_and_load(payload, in_tree, out_tree)
+
+
+#: tamper modes probed through the VERIFIED loader — every one must
+#: route to miss. Bit-level blob damage is only probed here (the hash
+#: check rejects it before a byte is unpickled); the naive loader is
+#: never pointed at a damaged pickle stream.
+VERIFIED_TAMPERS = ("blob-zero-fill", "blob-truncate", "blob-bit-flip",
+                    "manifest-torn", "manifest-version-skew",
+                    "manifest-key-swap", "stale-weights-key")
+
+#: manifest-level tampers probed through the NAIVE loader (fixtures
+#: only): it ignores the manifest, so it survives all of them — the
+#: E6 findings a checks-skipping loader earns.
+NAIVE_TAMPERS = ("manifest-torn", "manifest-version-skew",
+                 "manifest-key-swap")
+
+
+def _apply_tamper(edir: str, tamper: str, components: Dict) -> Dict:
+    """Damage one aspect of the entry copy at ``edir``; returns the
+    components the probe should LOAD WITH (differs only for the
+    stale-key probe)."""
+    bpath = os.path.join(edir, _BLOB)
+    mpath = os.path.join(edir, _MANIFEST)
+    if tamper == "blob-zero-fill":
+        n = os.path.getsize(bpath)
+        with open(bpath, "wb") as f:
+            f.write(b"\0" * n)
+    elif tamper == "blob-truncate":
+        with open(bpath, "rb") as f:
+            raw = f.read()
+        with open(bpath, "wb") as f:
+            f.write(raw[:len(raw) // 2])
+    elif tamper == "blob-bit-flip":
+        with open(bpath, "rb") as f:
+            raw = bytearray(f.read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(bpath, "wb") as f:
+            f.write(bytes(raw))
+    elif tamper == "manifest-torn":
+        with open(mpath, encoding="utf-8") as f:
+            text = f.read()
+        with open(mpath, "w", encoding="utf-8") as f:
+            f.write(text[:len(text) // 2])
+    elif tamper == "manifest-version-skew":
+        with open(mpath, encoding="utf-8") as f:
+            manifest = json.load(f)
+        manifest.setdefault("key", {})["jax"] = "0.0.0-skewed"
+        with open(mpath, "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+    elif tamper == "manifest-key-swap":
+        with open(mpath, encoding="utf-8") as f:
+            manifest = json.load(f)
+        manifest.setdefault("key", {})["weights"] = "0" * 16
+        with open(mpath, "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+    elif tamper == "stale-weights-key":
+        # the entry is untouched; the PROBE asks for a different
+        # weights fingerprint — the loader must miss (different digest,
+        # and even a relocated entry fails the verbatim key check)
+        return dict(components, weights="f" * 16)
+    else:
+        raise ValueError(f"unknown tamper {tamper!r}")
+    return components
+
+
+def integrity_probes(root: str, components: Dict,
+                     naive: bool = False) -> List[Dict]:
+    """Fault-inject COPIES of the entry and record whether any load
+    path survives. ``survived=True`` is an E6 finding. The entry at
+    ``root`` itself is never touched."""
+    from raft_tpu.serving import aot
+
+    src = aot.AOTCache(root).entry_dir(components)
+    probes: List[Dict] = []
+    tampers = NAIVE_TAMPERS if naive else VERIFIED_TAMPERS
+    for tamper in tampers:
+        tmp = tempfile.mkdtemp(prefix="graftexport-probe-")
+        try:
+            cache = aot.AOTCache(tmp)
+            edir = cache.entry_dir(components)
+            os.makedirs(os.path.dirname(edir), exist_ok=True)
+            shutil.copytree(src, edir)
+            load_with = _apply_tamper(edir, tamper, components)
+            if naive:
+                try:
+                    survived = _naive_load(edir) is not None
+                    note = "naive loader ignored the manifest"
+                except Exception as exc:  # noqa: BLE001
+                    survived = False
+                    note = f"{type(exc).__name__}"
+            else:
+                exe = cache.load(load_with)
+                survived = exe is not None
+                note = cache.last_miss if not survived else "LOADED"
+            probes.append({"tamper": tamper,
+                           "loader": "naive" if naive else "verified",
+                           "survived": bool(survived), "note": note})
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return probes
+
+
+# -- the builder ----------------------------------------------------------
+
+def _read_entry(root: str, components: Dict) -> Dict:
+    from raft_tpu.serving import aot
+
+    edir = aot.AOTCache(root).entry_dir(components)
+    out = {"manifest": {}, "blob_bytes": 0}
+    try:
+        with open(os.path.join(edir, _MANIFEST), encoding="utf-8") as f:
+            out["manifest"] = json.load(f)
+        out["blob_bytes"] = os.path.getsize(os.path.join(edir, _BLOB))
+    except OSError:
+        pass
+    return out
+
+
+def _fixture_components(target: ExportTarget, donations,
+                        platform: str) -> Dict:
+    """A complete key for a fixture program, minus the fields the
+    fixture deliberately omits."""
+    from raft_tpu.serving import aot
+    import jax
+    import jaxlib
+
+    components = {
+        "format": aot.AOT_FORMAT,
+        "program": target.name,
+        "weights": "fixture-" + ("0" * 8),
+        "geometry": [],
+        "wire": "f32",
+        "iters": 0,
+        "config": "fixture",
+        "donations": sorted(int(i) for i in donations),
+        "partition": "single",
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": platform,
+    }
+    for field_name in target.omit_key_fields:
+        components.pop(field_name, None)
+    return components
+
+
+def build_artifacts(target: ExportTarget) -> ExportArtifacts:
+    """Round-trip one target through serialize→deserialize and bundle
+    what the rules need."""
+    jax = ensure_cpu()
+    from raft_tpu.serving import aot
+
+    t0 = time.perf_counter()
+    art = ExportArtifacts()
+    art.platform = jax.default_backend()
+    tmp_root: Optional[str] = None
+    try:
+        if target.kind == "engine":
+            engine, (b, h, w), flags = target.build()
+            cache = engine._aot
+            if cache is None:
+                raise ValueError(f"target {target.name}: engine has no "
+                                 "aot_cache — nothing to audit")
+            if flags.get("ragged"):
+                bucket = engine.ensure_ragged(b, h, w)
+                live_exe = engine._compiled_ragged[bucket]
+            elif flags.get("cached"):
+                bucket = engine.ensure_bucket(b, h, w, cached=True)
+                live_exe = engine._compiled_cached[bucket]
+            else:
+                bucket = engine.ensure_bucket(b, h, w)
+                live_exe = engine._compiled[bucket]
+            art.key = engine._aot_key(bucket, **flags)
+            art.live_hlo = live_exe.as_text()
+            # the live half, re-derived from the SAME recipe the
+            # engine compiled (bucket_program is the public seam)
+            fn, args = engine.bucket_program(bucket, **flags)
+            lowered = fn.lower(*args)
+            art.lowered_text = lowered.as_text()
+            art.engine_signature = aot.build_signature(args, lowered)
+            entry = _read_entry(cache.root, art.key)
+            art.manifest = entry["manifest"]
+            art.blob_bytes = entry["blob_bytes"]
+            probe_cache = aot.AOTCache(cache.root)
+            loaded = probe_cache.load(art.key)
+            if loaded is None:
+                art.serialize_error = ("verified load of the freshly "
+                                       "stored entry failed: "
+                                       f"{probe_cache.last_miss}")
+            else:
+                art.loaded_hlo = loaded.as_text()
+            art.probes = integrity_probes(cache.root, art.key,
+                                          naive=target.naive_loader)
+        elif target.kind == "fn":
+            fn, args, donate = target.build()
+            jitted = jax.jit(fn, donate_argnums=tuple(donate))
+            lowered = jitted.lower(*args)
+            art.lowered_text = lowered.as_text()
+            # fresh_compile: these executables feed _write_entry_raw —
+            # a jax-persistent-cache-deserialized executable serializes
+            # to a payload that can never load back, and the test
+            # processes run with that cache enabled
+            with aot.fresh_compile():
+                compiled = lowered.compile()
+            art.live_hlo = compiled.as_text()
+            art.engine_signature = aot.build_signature(args, lowered)
+            art.key = _fixture_components(target, donate, art.platform)
+            if target.drop_donation_on_serialize:
+                # a serialization path that loses the alias map: the
+                # blob comes from a NON-donating compile of the same fn
+                with aot.fresh_compile():
+                    to_store = jax.jit(fn).lower(*args).compile()
+            else:
+                to_store = compiled
+            tmp_root = tempfile.mkdtemp(prefix="graftexport-fix-")
+            try:
+                _write_entry_raw(
+                    tmp_root, art.key, to_store, lowered, tuple(args),
+                    platform_claim=target.platform_claim,
+                    tamper_signature=target.tamper_signature)
+            except Exception as exc:  # noqa: BLE001 — e.g. callbacks
+                art.serialize_error = f"{type(exc).__name__}: {exc}"
+            if not art.serialize_error:
+                if target.platform_claim:
+                    art.key = dict(art.key,
+                                   platform=target.platform_claim)
+                entry = _read_entry(tmp_root, art.key)
+                art.manifest = entry["manifest"]
+                art.blob_bytes = entry["blob_bytes"]
+                probe_cache = aot.AOTCache(tmp_root)
+                loaded = probe_cache.load(art.key)
+                if loaded is not None:
+                    art.loaded_hlo = loaded.as_text()
+                art.probes = integrity_probes(
+                    tmp_root, art.key, naive=target.naive_loader)
+        else:
+            raise ValueError(f"target {target.name}: unknown kind "
+                             f"{target.kind!r} (engine|fn)")
+    finally:
+        if tmp_root is not None:
+            shutil.rmtree(tmp_root, ignore_errors=True)
+    art.seconds = time.perf_counter() - t0
+    return art

@@ -1,0 +1,355 @@
+"""graftexport driver: round-trip the serve programs, run E1–E6,
+baseline.
+
+Usage (from the repo root; this exact bare invocation is the tier-1
+gate, ``tests/test_graftexport.py``)::
+
+    python -m tools.graftexport --json
+
+Exit codes mirror the sibling tiers: 0 clean (modulo baseline), 1 new
+findings or stale baseline entries, 2 usage error. The baseline
+(``tools/graftexport/baseline.json``) is SHRINK-ONLY and ships EMPTY —
+the first scan's findings were fixed at the site (aot.py's store/load
+grew checks, not waivers), and new ones are fixed or waived with
+justification, never grandfathered.
+
+Suppression: serialized artifacts have no source line, so the pragma
+analog is a :class:`~tools.graftexport.spec.Waiver` on the target
+declaration — rule id + detail substring + REQUIRED justification.
+
+Caching: compiling + serializing + reloading + fault-probing the four
+serve programs costs tens of seconds; repeats are served from the
+shared ``tools/lintcache.py`` cache. Entries are keyed on the artifact
+key (a content hash over every ``raft_tpu/**/*.py`` — the sources that
+decide the serialized artifacts — plus the jax version) and the active
+rule set, under a package signature covering this tool and lintcache
+itself; editing any serving/model source, rule, or the cache machinery
+rebuilds, an untouched tree answers warm in seconds with no jax import
+at all. ``--no-cache`` forces a rebuild.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from collections import Counter
+from dataclasses import asdict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools import lintcache
+
+from .finding import ExportFinding
+from .spec import ExportTarget
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.json")
+_TARGETS_PY = os.path.join(_HERE, "targets.py")
+CACHE_ENV = "RAFT_GRAFTEXPORT_CACHE"
+CACHE_FILE = "graftexport_cache.json"
+
+
+# -- audit ----------------------------------------------------------------
+
+def audit_one(target: ExportTarget, rules
+              ) -> Tuple[List[ExportFinding], float]:
+    """Build one target's round-trip artifacts and run ``rules`` over
+    them. Waivers are applied here — a waived finding never reaches
+    the baseline logic (or the cache), same as a pragma'd graftlint
+    finding."""
+    from .artifacts import build_artifacts
+
+    art = build_artifacts(target)
+    findings: List[ExportFinding] = []
+    for mod in rules:
+        for f in mod.check(target, art):
+            if not target.waived(f.rule, f.detail):
+                findings.append(f)
+    return findings, art.seconds
+
+
+def audit_targets(targets: Sequence[ExportTarget], rules=None
+                  ) -> Tuple[List[ExportFinding], Dict[str, float]]:
+    """Uncached audit over ``targets`` (fixtures, library callers).
+    Returns ``(findings, seconds per target)``."""
+    from .rules import ALL_RULES
+
+    rules = ALL_RULES if rules is None else rules
+    findings: List[ExportFinding] = []
+    seconds: Dict[str, float] = {}
+    for t in targets:
+        got, dt = audit_one(t, rules)
+        findings.extend(got)
+        seconds[t.name] = dt
+    return findings, seconds
+
+
+# -- cache ----------------------------------------------------------------
+
+def artifact_key() -> str:
+    """Content hash over the sources that decide the serialized
+    artifacts (every ``raft_tpu/**/*.py``) + the jax version — cheap
+    enough for the warm path (no jax import), strong enough that any
+    change to the serving, model, or cache-seam code rebuilds."""
+    sig = lintcache.package_signature(os.path.join(_REPO, "raft_tpu"))
+    try:
+        from importlib.metadata import version
+        jver = version("jax")
+    except Exception:
+        jver = "?"
+    return f"{sig}-jax{jver}"
+
+
+def _tool_signature() -> str:
+    # every module the findings depend on: this package, the cache
+    # machinery, AND the shared alias parser E2 calls out to — a fixed
+    # regex in hlo_lib's input_output_alias scan must invalidate cached
+    # findings, or the warm gate would answer clean from code that no
+    # longer exists ("a cache must never outlive the code that
+    # produced it")
+    return lintcache.package_signature(
+        _HERE,
+        os.path.join(_REPO, "tools", "lintcache.py"),
+        os.path.join(_REPO, "tools", "hlo_lib.py"))
+
+
+def cached_audit(targets: Sequence[ExportTarget], rules, cache_path: str
+                 ) -> Tuple[List[ExportFinding], Dict[str, float],
+                            Dict[str, bool]]:
+    """Repo-target audit through the lintcache file: per-target entries
+    keyed on (targets.py, artifact key, target name + rule ids).
+    Returns ``(findings, seconds, hit map)``."""
+    rule_key = ",".join(m.RULE for m in rules)
+    digest = artifact_key()
+    cache = lintcache.load_cache(cache_path, _tool_signature())
+    findings: List[ExportFinding] = []
+    seconds: Dict[str, float] = {}
+    hits: Dict[str, bool] = {}
+    dirty = False
+    for t in targets:
+        key = lintcache.cache_key(_TARGETS_PY, digest,
+                                  f"{t.name}|{rule_key}")
+        entry = cache["files"].get(key)
+        if entry is not None:
+            findings.extend(ExportFinding(**f)
+                            for f in entry["findings"])
+            seconds[t.name] = 0.0
+            hits[t.name] = True
+            continue
+        got, dt = audit_one(t, rules)
+        findings.extend(got)
+        seconds[t.name] = dt
+        hits[t.name] = False
+        cache["files"][key] = {"findings": [asdict(f) for f in got],
+                               "built_s": round(dt, 2)}
+        dirty = True
+    if dirty:
+        lintcache.evict_dead_entries(cache, {_TARGETS_PY: digest})
+        lintcache.save_cache(cache_path, cache)
+    return findings, seconds, hits
+
+
+# -- fixtures -------------------------------------------------------------
+
+def load_fixture_targets(path: str) -> List[ExportTarget]:
+    """TARGETS from a fixture module file (tests/graftexport_fixtures)."""
+    name = "graftexport_fixture_" + \
+        os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        raise OSError(f"cannot import fixture module {path}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return list(mod.TARGETS)
+
+
+# -- baseline (same shrink-only semantics as the sibling tiers') ----------
+
+def load_baseline(path: str) -> Counter:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return Counter((e["target"], e["rule"], e["detail"])
+                   for e in data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Iterable[ExportFinding]) -> None:
+    entries = [{"target": k[0], "rule": k[1], "detail": k[2]}
+               for k in sorted(f.key() for f in findings)]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({
+            "comment": "graftexport grandfathered findings — burn down, "
+                       "never grow; regenerate with --write-baseline "
+                       "after fixing one. Ships EMPTY: the first scan's "
+                       "findings were fixed at the site (aot.py grew "
+                       "checks), and tests/test_graftexport.py pins "
+                       "it empty.",
+            "findings": entries,
+        }, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: List[ExportFinding], baseline: Counter,
+                   audited_targets: Optional[Iterable[str]] = None,
+                   ) -> Tuple[List[ExportFinding],
+                              List[Tuple[str, str, str]]]:
+    """(new findings, stale keys). An unconsumed entry whose target WAS
+    audited is stale and fails the run — it would silently grandfather
+    the next reintroduction; an entry for a target outside this run
+    (--targets subset) is merely unchecked."""
+    remaining = Counter(baseline)
+    new: List[ExportFinding] = []
+    for f in findings:
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+        else:
+            new.append(f)
+    if audited_targets is not None:
+        audited = set(audited_targets)
+        checked = (lambda k: k[0] in audited)
+    else:
+        checked = (lambda k: True)
+    stale = sorted(k for k, n in remaining.items() if checked(k)
+                   for _ in range(n))
+    return new, stale
+
+
+# -- CLI ------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftexport",
+        description="Serialized-executable invariant checker (rules "
+                    "E1-E6 over the serialize→deserialize round trip "
+                    "of the real serve programs through the AOT "
+                    "artifact cache; see tools/graftexport/rules/).")
+    p.add_argument("--baseline", metavar="JSON", default=DEFAULT_BASELINE,
+                   help="grandfather file (default: the committed "
+                        "tools/graftexport/baseline.json — pinned EMPTY)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (list of findings)")
+    p.add_argument("--write-baseline", metavar="JSON",
+                   help="write current findings as the new baseline "
+                        "and exit 0")
+    p.add_argument("--targets", metavar="T1,T2",
+                   help="audit only these targets")
+    p.add_argument("--rules", metavar="E1,E2,...",
+                   help="run only these rule ids")
+    p.add_argument("--fixture", metavar="PY",
+                   help="audit the TARGETS of this fixture module "
+                        "instead of the repo registry (no default "
+                        "baseline, no cache)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="rebuild artifacts even on a warm cache")
+    p.add_argument("--cache", metavar="JSON",
+                   default=lintcache.default_cache_path(CACHE_ENV,
+                                                        CACHE_FILE),
+                   help="findings cache file (default: the shared "
+                        f"user cache, override with ${CACHE_ENV})")
+    args = p.parse_args(argv)
+
+    from .rules import ALL_RULES
+
+    rules = ALL_RULES
+    if args.rules:
+        want = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [m for m in ALL_RULES if m.RULE in want]
+        unknown = want - {m.RULE for m in rules}
+        if unknown:
+            print(f"graftexport: unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    if args.write_baseline and (args.rules or args.targets):
+        print("graftexport: refusing --write-baseline with --rules/"
+              "--targets — regenerate from a full run",
+              file=sys.stderr)
+        return 2
+
+    fixture_run = bool(args.fixture)
+    if fixture_run:
+        # fixtures import jax at module scope (sibling-tier idiom):
+        # point a fresh interpreter at the CPU backend FIRST
+        from .artifacts import prepare_env
+        prepare_env()
+        try:
+            targets = load_fixture_targets(args.fixture)
+        # exec_module can raise anything (ImportError, a jax error at
+        # module scope) — all of it is "unloadable fixture", exit 2
+        except Exception as exc:  # noqa: BLE001
+            print(f"graftexport: unloadable fixture {args.fixture}: "
+                  f"{exc}", file=sys.stderr)
+            return 2
+        if args.baseline == DEFAULT_BASELINE:
+            args.baseline = None
+    else:
+        from .targets import export_targets
+        targets = export_targets()
+    if args.targets:
+        want_t = {t.strip() for t in args.targets.split(",")}
+        unknown_t = want_t - {t.name for t in targets}
+        if unknown_t:
+            print(f"graftexport: unknown target(s): {sorted(unknown_t)}",
+                  file=sys.stderr)
+            return 2
+        targets = [t for t in targets if t.name in want_t]
+
+    if fixture_run or args.no_cache:
+        findings, seconds = audit_targets(targets, rules=rules)
+        hits = {}
+    else:
+        findings, seconds, hits = cached_audit(targets, rules,
+                                               args.cache)
+    for tname, dt in seconds.items():
+        how = "cache" if hits.get(tname) else f"{dt:.1f}s"
+        print(f"graftexport: {tname} audited in {how}", file=sys.stderr)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print(f"graftexport: wrote {len(findings)} finding(s) to "
+              f"{args.write_baseline}", file=sys.stderr)
+        return 0
+
+    stale: List[Tuple[str, str, str]] = []
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"graftexport: unreadable baseline "
+                  f"{args.baseline}: {exc}", file=sys.stderr)
+            return 2
+        active = {m.RULE for m in rules}
+        baseline = Counter({k: v for k, v in baseline.items()
+                            if k[1] in active})
+        findings, stale = apply_baseline(
+            findings, baseline,
+            audited_targets=[t.name for t in targets])
+
+    if args.as_json:
+        print(json.dumps([{
+            "target": f.target, "rule": f.rule, "name": f.name,
+            "detail": f.detail, "message": f.message,
+        } for f in findings] + [{
+            "target": k[0], "rule": "B0", "name": "stale-baseline",
+            "detail": k[2],
+            "message": f"stale baseline entry for {k[1]}: {k[2]!r} — "
+                       "regenerate with --write-baseline",
+        } for k in stale], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"graftexport: {len(findings)} new finding(s)",
+                  file=sys.stderr)
+    if stale:
+        for k in stale:
+            print(f"graftexport: stale baseline entry {k[0]} [{k[1]}] "
+                  f"{k[2]!r}", file=sys.stderr)
+        print(f"graftexport: {len(stale)} stale baseline entr(y/ies) — "
+              "the finding was fixed (good!) but the entry must go: "
+              "regenerate with --write-baseline so it cannot "
+              "grandfather a future reintroduction", file=sys.stderr)
+    return 1 if (findings or stale) else 0

@@ -1,0 +1,34 @@
+"""The one record type every graftexport rule emits.
+
+Identical shape to its siblings' (graftaudit/graftshard): an export
+finding anchors to a *target* (one serve program round-tripped through
+the AOT serialize/load seam) plus a stable ``detail`` string (key
+component name, flat-arg index, constant type, tamper mode) — the
+detail IS the baseline identity, since serialized artifacts have no
+line numbers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class ExportFinding:
+    target: str    # export target name, e.g. "serve_u8_warm"
+    rule: str      # "E1".."E6"
+    name: str      # kebab-case rule name, e.g. "incomplete-cache-key"
+    detail: str    # stable identity inside the artifact (key field,
+                   # param index, constant type, tamper mode)
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.target}: {self.rule}[{self.name}] "
+                f"{self.message}")
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: details derive from key-field names,
+        flat param indices and tamper-mode names, which survive
+        recompiles of the same program."""
+        return (self.target, self.rule, self.detail)

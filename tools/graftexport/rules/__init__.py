@@ -1,0 +1,16 @@
+"""graftexport rules E1–E6, one module per export bug class.
+
+Every module exports ``RULE`` (the id), ``NAME`` (kebab-case), and
+``check(target, art) -> List[ExportFinding]``. Waivers are applied by
+the driver, not here.
+"""
+
+from . import cache_key               # noqa: F401  (E1)
+from . import donation_serialize      # noqa: F401  (E2)
+from . import baked_literals          # noqa: F401  (E3)
+from . import portability             # noqa: F401  (E4)
+from . import signature_drift         # noqa: F401  (E5)
+from . import integrity               # noqa: F401  (E6)
+
+ALL_RULES = [cache_key, donation_serialize, baked_literals,
+             portability, signature_drift, integrity]

@@ -1,0 +1,77 @@
+"""E3: multi-MB literals baked into a serialized program.
+
+An artifact's size discipline: the engine passes weights as ARGUMENTS
+(the cache key carries their fingerprint, the blob carries none of
+their bytes) — so a serve artifact's constants are coordinate grids
+and norm epsilons, a few KiB. A closure-captured weight tree instead
+shows up as multi-MB ``stablehlo.constant`` payloads, which triples
+artifact size, bloats every replica's download, and — worse — bakes a
+SPECIFIC checkpoint into a blob whose key claims weights-independence
+via the fingerprint field: update_weights would swap the key while
+the old weights ride along inside the program.
+
+Detection runs on the LOWERED StableHLO (constants are explicit
+``stablehlo.constant dense<...> : tensor<...>`` ops there; the
+optimized module re-encodes them) and prices each constant from its
+tensor type — the dense payload in text form is elided for large
+literals, the type never is.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..finding import ExportFinding
+from ..spec import ExportArtifacts, ExportTarget
+
+RULE = "E3"
+NAME = "baked-weight-literal"
+
+_CONST_RE = re.compile(
+    r"stablehlo\.constant[^\n]*?:\s*tensor<([^>]+)>")
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+    "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1,
+    "f8E4M3FN": 1, "f8E5M2": 1, "f8E4M3B11FNUZ": 1,
+}
+
+
+def _tensor_bytes(spec: str) -> int:
+    """``"1x768x768xf32"`` -> 2359296. Unknown dtypes price at 4."""
+    parts = spec.split("x")
+    dtype = parts[-1]
+    n = 1
+    for d in parts[:-1]:
+        if d.isdigit():
+            n *= int(d)
+        elif d == "?":          # dynamic dim: price what we can see
+            continue
+        else:
+            return 0            # not a ranked numeric tensor
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def check(target: ExportTarget, art: ExportArtifacts
+          ) -> List[ExportFinding]:
+    if not art.lowered_text:
+        return []
+    budget = target.baked_literal_bytes_max
+    out: List[ExportFinding] = []
+    seen = set()
+    for m in _CONST_RE.finditer(art.lowered_text):
+        spec = m.group(1).strip()
+        size = _tensor_bytes(spec)
+        if size <= budget or spec in seen:
+            continue
+        seen.add(spec)
+        out.append(ExportFinding(
+            target.name, RULE, NAME, f"tensor<{spec}>",
+            f"constant tensor<{spec}> bakes {size:,} bytes into the "
+            f"serialized program ({budget:,}-byte budget) — weights "
+            "belong in ARGUMENTS keyed by the weights fingerprint, "
+            "not inside the blob where update_weights can't reach "
+            "them"))
+    return out

@@ -1,0 +1,63 @@
+"""E1: incomplete cache key — the stale-load hazard.
+
+A serialized executable is only as trustworthy as the key that names
+it. Every component in ``REQUIRED_KEY_FIELDS`` exists because two
+programs differing ONLY in that component would otherwise collide on
+one digest and the second process would load the first's bytes: a
+weights fingerprint missing means a promoted model serves the old
+model's artifact; a missing jax/jaxlib version means an executable
+deserializes into a runtime with a different calling convention; a
+missing partition hash means a 4-device blob loads into an 8-device
+assembly. The production store refuses incomplete keys by
+construction (``aot.store`` raises) — this rule audits the MANIFESTS
+actually on disk, which is what catches entries written by an older
+writer, a third-party exporter, or a hand-edited artifact dir.
+
+An empty/falsy value is as bad as an absent field: ``"weights": ""``
+hashes fine and collides just the same.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import ExportFinding
+from ..spec import REQUIRED_KEY_FIELDS, ExportArtifacts, ExportTarget
+
+RULE = "E1"
+NAME = "incomplete-cache-key"
+
+#: fields where 0/[] is a legitimate value (a program with no
+#: donations donates []; iters could legitimately be absent from a
+#: fixture at 0)
+_FALSY_OK = frozenset({"donations", "geometry", "iters"})
+
+
+def check(target: ExportTarget, art: ExportArtifacts
+          ) -> List[ExportFinding]:
+    if art.serialize_error or not art.manifest:
+        return []
+    key = art.manifest.get("key")
+    if not isinstance(key, dict):
+        return [ExportFinding(
+            target.name, RULE, NAME, "no key",
+            "manifest carries no key dict at all — the entry cannot "
+            "be verified against anything; any blob parked at this "
+            "digest would load")]
+    out: List[ExportFinding] = []
+    for field_name in sorted(REQUIRED_KEY_FIELDS - set(key)):
+        out.append(ExportFinding(
+            target.name, RULE, NAME, f"missing {field_name}",
+            f"cache key omits '{field_name}' — two programs differing "
+            f"only in {field_name} collide on one digest and the "
+            "loser serves the winner's executable"))
+    for field_name in sorted(set(key) & REQUIRED_KEY_FIELDS):
+        v = key[field_name]
+        if not v and field_name not in _FALSY_OK and not isinstance(
+                v, (int, float)):
+            out.append(ExportFinding(
+                target.name, RULE, NAME, f"empty {field_name}",
+                f"cache key component '{field_name}' is empty — an "
+                "empty value hashes fine and collides exactly like a "
+                "missing one"))
+    return out

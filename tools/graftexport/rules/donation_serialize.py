@@ -1,0 +1,46 @@
+"""E2: donation dropped by serialization.
+
+graftaudit H4 proves XLA honors the engine's donations in the LIVE
+compile; graftshard S6 proves they survive partitioning. This rule
+closes the last gap: the serialize→deserialize round trip. The whole
+zero-copy warm-start story (donated flow_init → flow_low, donated
+cache rows) is an ``input_output_alias`` map inside the executable —
+if the serialized artifact loses it, every replica that LOADS instead
+of compiles silently pays an input-sized copy per call, and the fleet
+regresses exactly where the cache was supposed to help most.
+
+Detection: flat params aliased in the live optimized module
+(``parse_aliased_params``) must be aliased in the RELOADED
+executable's module too. The live module is the ground truth — params
+XLA already declined (shape-mismatch etc.) are H4's finding, not
+ours.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import ExportFinding
+from ..spec import ExportArtifacts, ExportTarget
+
+RULE = "E2"
+NAME = "donation-dropped-by-serialization"
+
+
+def check(target: ExportTarget, art: ExportArtifacts
+          ) -> List[ExportFinding]:
+    if art.serialize_error or not (art.live_hlo and art.loaded_hlo):
+        return []
+    from tools import hlo_lib
+
+    live = hlo_lib.parse_aliased_params(art.live_hlo)
+    loaded = hlo_lib.parse_aliased_params(art.loaded_hlo)
+    out: List[ExportFinding] = []
+    for ix in sorted(live - loaded):
+        out.append(ExportFinding(
+            target.name, RULE, NAME, f"param {ix}",
+            f"flat param {ix} is input_output_alias'd in the live "
+            "compile but NOT in the deserialized executable — the "
+            "serialized artifact lost the donation and every loading "
+            "replica pays an input-sized copy per call"))
+    return out

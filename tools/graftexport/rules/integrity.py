@@ -1,0 +1,56 @@
+"""E6: cache-integrity discipline, proven by fault injection.
+
+Rules E1–E5 audit what a writer PUT on disk; this rule audits what a
+loader will ACCEPT. The driver copies the freshly-written entry and
+damages each copy one way — blob zero-fill, truncation, a single bit
+flip, a torn manifest, a jax-version skew, a swapped weights key, a
+stale-key probe — then runs the load path. The contract
+(``aot.AOTCache.load``'s docstring, drilled by the ``aot.load`` chaos
+site) is that EVERY one of these reads as a clean miss. A probe the
+loader SURVIVES is the finding: some integrity check is missing or
+bypassed, and real bit rot / version skew / artifact swaps would
+serve a wrong or corrupt executable instead of recompiling.
+
+A target opting into ``naive_loader=True`` (fixtures only) probes a
+manifest-ignoring loader instead — the counterfactual that shows
+what each check protects against.
+
+For engine targets a failed serialize round-trip is ALSO reported
+here: the production store is expected to round-trip its own
+programs, and a silent serialize failure means every replica
+recompiles while believing it has a warm cache.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import ExportFinding
+from ..spec import ExportArtifacts, ExportTarget
+
+RULE = "E6"
+NAME = "integrity-check-bypassed"
+
+
+def check(target: ExportTarget, art: ExportArtifacts
+          ) -> List[ExportFinding]:
+    out: List[ExportFinding] = []
+    if art.serialize_error and target.kind == "engine":
+        out.append(ExportFinding(
+            target.name, RULE, NAME, "serialize round-trip",
+            "the production store failed to round-trip this engine "
+            f"program: {art.serialize_error} — replicas would "
+            "recompile on every start while believing the cache is "
+            "warm"))
+    for probe in art.probes:
+        if not probe.get("survived"):
+            continue
+        tamper = probe.get("tamper", "?")
+        loader = probe.get("loader", "verified")
+        out.append(ExportFinding(
+            target.name, RULE, NAME, f"{loader}:{tamper}",
+            f"a {tamper!r}-damaged entry LOADED through the {loader} "
+            "load path — the integrity check that should route this "
+            "to miss-and-recompile is missing or bypassed, so real "
+            "corruption/skew would serve a wrong executable"))
+    return out

@@ -1,0 +1,68 @@
+"""E4: non-portable artifact — custom calls and dishonest platform
+claims.
+
+The artifact dir's whole value is that ANOTHER process loads the
+blob. Two things quietly break that: (1) custom-call targets that
+resolve against the writing process — host callbacks hold a pointer
+into the writer's Python heap; platform kernels resolve only on the
+backend that registered them. A blob carrying one either fails to
+deserialize elsewhere (best case) or calls into garbage. (2) a
+manifest whose ``platform`` claim differs from the backend that
+actually compiled the blob: the key then routes a CPU-compiled
+executable to TPU replicas, and the load-time version checks can't
+save you because the key LIES.
+
+Sharding annotations (``Sharding``/``SPMDFullToShardShape``/...) are
+allowlisted: they are partitioner metadata the loading runtime
+re-resolves, present in every mesh program by construction.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..finding import ExportFinding
+from ..spec import ExportArtifacts, ExportTarget
+
+RULE = "E4"
+NAME = "non-portable-artifact"
+
+_STABLEHLO_CC = re.compile(r"stablehlo\.custom_call\s+@([\w.$~-]+)")
+_HLO_CC = re.compile(r'custom_call_target="([^"]+)"')
+
+
+def check(target: ExportTarget, art: ExportArtifacts
+          ) -> List[ExportFinding]:
+    out: List[ExportFinding] = []
+    allow = set(target.custom_call_allowlist)
+    seen = set()
+    for text, where in ((art.lowered_text, "lowered"),
+                        (art.live_hlo, "optimized")):
+        if not text:
+            continue
+        regex = _STABLEHLO_CC if where == "lowered" else _HLO_CC
+        for m in regex.finditer(text):
+            name = m.group(1)
+            if name in allow or name in seen:
+                continue
+            seen.add(name)
+            out.append(ExportFinding(
+                target.name, RULE, NAME, f"custom_call {name}",
+                f"custom call '{name}' ({where} module) pins the "
+                "artifact to the process/platform that wrote it — a "
+                "loading replica resolves it against nothing (or "
+                "worse, against a stale pointer); keep host "
+                "callbacks out of serialized programs or allowlist "
+                "the target with a justification"))
+    claimed = ""
+    if isinstance(art.manifest.get("key"), dict):
+        claimed = str(art.manifest["key"].get("platform", ""))
+    if claimed and art.platform and claimed != art.platform:
+        out.append(ExportFinding(
+            target.name, RULE, NAME, "platform-claim",
+            f"manifest claims platform '{claimed}' but the blob was "
+            f"compiled on '{art.platform}' — the key routes this "
+            "executable to replicas whose backend never produced it, "
+            "and load-time verification trusts the claim"))
+    return out

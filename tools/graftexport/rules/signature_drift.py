@@ -1,0 +1,68 @@
+"""E5: calling-convention drift between the artifact and the engine.
+
+The manifest records the signature the blob was serialized under:
+flat input avals, flat output avals, donated flat params. The engine,
+loading, dispatches against its OWN live recipe
+(``bucket_program`` → args + lowering). If the two drift — a config
+rename reorders operands, a wire-dtype change flips an input aval, a
+donation list changes — the loaded executable either throws at
+dispatch (best case) or reinterprets buffers (worst). The cache key
+catches most drift by construction (config/wire/donations are key
+components); this rule is the belt-and-braces audit that the
+SIGNATURE a writer recorded actually matches the recipe the loading
+engine would feed it, catching writers whose key was complete but
+whose recorded convention is wrong (or tampered).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..finding import ExportFinding
+from ..spec import ExportArtifacts, ExportTarget
+
+RULE = "E5"
+NAME = "calling-convention-drift"
+
+
+def _diff(kind: str, live, recorded, target, out):
+    if recorded is None:
+        return
+    live = list(live or [])
+    recorded = list(recorded or [])
+    if live == recorded:
+        return
+    n = max(len(live), len(recorded))
+    for i in range(n):
+        lv = live[i] if i < len(live) else "(absent)"
+        rv = recorded[i] if i < len(recorded) else "(absent)"
+        if lv == rv:
+            continue
+        out.append(ExportFinding(
+            target.name, RULE, NAME, f"{kind}[{i}]",
+            f"signature {kind}[{i}] drifted: engine's live recipe "
+            f"says {lv!r}, artifact manifest recorded {rv!r} — the "
+            "loaded executable would be dispatched with buffers it "
+            "was not compiled for"))
+
+
+def check(target: ExportTarget, art: ExportArtifacts
+          ) -> List[ExportFinding]:
+    if art.serialize_error or not art.manifest:
+        return []
+    recorded = art.manifest.get("signature")
+    live = art.engine_signature
+    if not isinstance(recorded, dict) or not live:
+        return []
+    out: List[ExportFinding] = []
+    _diff("in", live.get("in"), recorded.get("in"), target, out)
+    _diff("out", live.get("out"), recorded.get("out"), target, out)
+    ld = sorted(live.get("donations") or [])
+    rd = recorded.get("donations")
+    if rd is not None and sorted(rd) != ld:
+        out.append(ExportFinding(
+            target.name, RULE, NAME, "donations",
+            f"donation signature drifted: live recipe donates {ld}, "
+            f"artifact recorded {sorted(rd)} — a loading engine "
+            "would free (or fail to free) the wrong input buffers"))
+    return out

@@ -1,0 +1,140 @@
+"""Export-audit target declarations: what round-trips, and what is
+waived.
+
+An ``ExportTarget`` names one program that goes through the AOT
+serialize→deserialize cycle (``raft_tpu/serving/aot.py``) plus the
+declared discipline the audit holds the ARTIFACT to: a complete cache
+key (E1), donations surviving serialization (E2), no baked weight
+literals (E3), portable custom calls and an honest platform claim
+(E4), a manifest signature matching the engine's live recipe (E5), and
+every corruption/skew probe routed to miss (E6).
+
+``Waiver`` is the sibling tiers' pragma analog, verbatim: rule id + a
+substring of the finding's ``detail`` + a REQUIRED justification,
+reviewed where the target is declared.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Tuple
+
+#: 1 MiB: ceiling for one literal baked into a serialized program
+#: (E3). The engine's serve programs carry weights as ARGUMENTS — their
+#: baked constants are coordinate grids and norm epsilons, well under
+#: 100 KiB at audit shapes — while a closure-captured weight tree shows
+#: up as multi-MB ``stablehlo.constant`` payloads. An artifact whose
+#: key claims weights-independence must never ship one.
+DEFAULT_BAKED_LITERAL_BYTES_MAX = 1 << 20
+
+#: custom-call targets a serialized artifact may carry and still load
+#: anywhere its key claims (sharding annotations are partitioner
+#: metadata, resolved by the loading runtime). Anything else — host
+#: callbacks, platform-specific kernels — pins the blob to the
+#: process/platform that wrote it (E4).
+PORTABLE_CUSTOM_CALLS = (
+    "Sharding",
+    "SPMDFullToShardShape",
+    "SPMDShardToFullShape",
+)
+
+#: literal mirror of ``raft_tpu.serving.aot.REQUIRED_KEY_FIELDS`` — on
+#: purpose: the warm cache path answers with no jax import (and no
+#: raft_tpu import) at all. Drift between the mirror and the live set
+#: is itself a gate failure — tests/test_graftexport.py pins both
+#: halves equal.
+REQUIRED_KEY_FIELDS = frozenset({
+    "format", "program", "weights", "geometry", "wire", "iters",
+    "config", "donations", "partition", "jax", "jaxlib", "platform",
+})
+
+
+@dataclass(frozen=True)
+class Waiver:
+    rule: str      # "E4"
+    match: str     # substring of the finding's detail
+    reason: str    # justification — empty reasons are rejected
+
+    def __post_init__(self):
+        if not self.reason.strip():
+            raise ValueError(
+                f"waiver for {self.rule} ({self.match!r}) has no "
+                "justification — waivers document intent or they are "
+                "just silent baselining")
+
+
+@dataclass(frozen=True)
+class ExportTarget:
+    """One audited serialize→deserialize round trip.
+
+    ``kind="engine"``: ``build()`` returns ``(engine, (b, h, w),
+    flags)`` — a ``RAFTEngine`` constructed with an ``aot_cache`` and
+    ``flags`` naming the program table (``{"cached": bool, "ragged":
+    bool}``). The driver ensures the bucket/class (the engine itself
+    serializes through the production store path), re-lowers the same
+    recipe via ``engine.bucket_program`` for the live half, reloads
+    the written entry through the verified loader, and fault-injects
+    copies of the entry for E6.
+
+    ``kind="fn"``: ``build()`` returns ``(fn, args, donate_argnums)``
+    — a raw program the driver jits/compiles and writes through a
+    LOW-LEVEL entry writer so fixtures can plant exactly one defect
+    via the knobs below (the production ``aot.store`` refuses most of
+    them by construction, which is the point).
+    """
+
+    name: str
+    build: Callable
+    kind: str = "engine"
+    #: key components to DROP from the written manifest (E1 fixtures —
+    #: models an older/third-party writer with an incomplete key)
+    omit_key_fields: Tuple[str, ...] = ()
+    #: serialize a non-donating compile of the same fn while the live
+    #: trace keeps its donations (E2 fixtures — models a serialization
+    #: path that loses the alias map)
+    drop_donation_on_serialize: bool = False
+    #: write this platform into the manifest key regardless of the
+    #: compiling backend (E4 fixtures — a dishonest platform claim)
+    platform_claim: str = ""
+    #: corrupt the manifest's signature block after the write (E5
+    #: fixtures — calling-convention drift between artifact and engine)
+    tamper_signature: bool = False
+    #: run the E6 probes through a NAIVE loader that ignores the
+    #: manifest (E6 fixtures — models a loader missing the integrity
+    #: checks; the real targets always probe the verified loader)
+    naive_loader: bool = False
+    baked_literal_bytes_max: int = DEFAULT_BAKED_LITERAL_BYTES_MAX
+    custom_call_allowlist: Tuple[str, ...] = PORTABLE_CUSTOM_CALLS
+    waivers: Tuple[Waiver, ...] = ()
+    notes: str = ""
+
+    def waived(self, rule: str, detail: str) -> bool:
+        return any(w.rule == rule and w.match in detail
+                   for w in self.waivers)
+
+
+@dataclass
+class ExportArtifacts:
+    """Everything the rules see for one target: the live lowering +
+    optimized HLO, the RELOADED executable's HLO, the manifest as
+    written to disk, the engine's live calling-convention record, and
+    the E6 probe outcomes. ``serialize_error`` is non-empty when the
+    round trip itself failed (some programs — host callbacks — cannot
+    serialize; rules that need the loaded half skip, E6 reports it for
+    engine targets)."""
+
+    key: Dict = field(default_factory=dict)        # components as used
+    lowered_text: str = ""                         # live StableHLO
+    live_hlo: str = ""                             # live optimized HLO
+    loaded_hlo: str = ""                           # reloaded exe's HLO
+    manifest: Dict = field(default_factory=dict)   # manifest.json
+    blob_bytes: int = 0
+    serialize_error: str = ""
+    #: live calling convention: {"in": [...], "out": [...],
+    #: "donations": [...]} — what E5 diffs the manifest against
+    engine_signature: Dict = field(default_factory=dict)
+    platform: str = ""                             # actual backend
+    #: E6 outcomes: {"tamper": ..., "loader": ..., "survived": bool,
+    #: "note": ...} — a surviving load IS the finding
+    probes: List[Dict] = field(default_factory=list)
+    seconds: float = 0.0

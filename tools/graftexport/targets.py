@@ -1,0 +1,99 @@
+"""The repo's real export-audit targets: the four serve programs.
+
+Shapes are deliberately tiny (32x32, batch 1, iters 1, small model) —
+every invariant the export rules check (key completeness, alias
+survival, baked-literal budget, custom-call portability, signature
+match, miss-routing) is decided by program/artifact STRUCTURE, which
+is shape-independent; tiny shapes just keep four CPU compiles inside
+the tier-1 budget.
+
+Module scope is jax-free on purpose: the warm-cache path of the gate
+answers without importing jax at all (tests pin that with a poisoned
+``jax`` shim on PYTHONPATH); everything heavy lives inside ``build``
+closures.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import List
+
+from .spec import ExportTarget
+
+_IMAGE_HW = (32, 32)
+_ITERS = 1
+
+_ENGINE_WEIGHTS = []   # [(variables, cfg)] — one real init, all targets
+
+
+def _engine_weights():
+    from .artifacts import ensure_cpu
+
+    jax = ensure_cpu()
+    import jax.numpy as jnp
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+
+    if not _ENGINE_WEIGHTS:
+        # small model: the audit exercises the serialize/load SEAM,
+        # not the net, and the small init/compile is ~4x cheaper
+        cfg = RAFTConfig(small=True)
+        model = RAFT(cfg)
+        h, w = _IMAGE_HW
+        img = jnp.zeros((1, h, w, 3))
+        variables = model.init(jax.random.PRNGKey(0), img, img,
+                               iters=1)
+        _ENGINE_WEIGHTS.append((variables, cfg))
+    return _ENGINE_WEIGHTS[0]
+
+
+def _build_engine(**engine_kw):
+    flags = {"cached": bool(engine_kw.pop("_cached", False)),
+             "ragged": bool(engine_kw.pop("_ragged", False))}
+
+    def build():
+        from .artifacts import ensure_cpu
+
+        ensure_cpu()
+        from raft_tpu.serving.engine import RAFTEngine
+
+        variables, cfg = _engine_weights()
+        h, w = _IMAGE_HW
+        # one throwaway cache dir per audit run: the audit writes the
+        # entry through the engine's own store path, then reloads and
+        # fault-probes it
+        root = tempfile.mkdtemp(prefix="graftexport-aot-")
+        eng = RAFTEngine(variables, cfg, iters=_ITERS,
+                         precompile=False, aot_cache=root,
+                         **engine_kw)
+        return eng, (1, h, w), flags
+    return build
+
+
+def export_targets() -> List[ExportTarget]:
+    return [
+        ExportTarget(
+            name="serve",
+            build=_build_engine(),
+            notes="plain f32 bucket — the default serve artifact"),
+        ExportTarget(
+            name="serve_u8_warm",
+            build=_build_engine(warm_start=True, wire="u8"),
+            notes="u8 wire + warm-start donation — the production "
+                  "wire config; E2's alias-survival check has real "
+                  "donations to lose here"),
+        ExportTarget(
+            name="serve_cached",
+            build=_build_engine(warm_start=True, wire="u8",
+                                feature_cache=True, _cached=True),
+            notes="feature-cache signature (fmap1/fmap2 operands + "
+                  "donations) — the widest calling convention E5 "
+                  "guards"),
+        ExportTarget(
+            name="serve_ragged",
+            build=_build_engine(warm_start=True, wire="u8",
+                                ragged=True, ragged_grain=32,
+                                _ragged=True),
+            notes="ragged rows program — grain 32 so the 32x32 audit "
+                  "shape is itself a capacity class"),
+    ]

@@ -43,7 +43,8 @@ from typing import (Callable, Dict, Iterable, List, Optional, Sequence,
 #: fixtures fail another tier's gate)
 EXCLUDED_DIRS = {"__pycache__", ".git", "graftlint_fixtures",
                  "graftaudit_fixtures", "graftthread_fixtures",
-                 "graftshard_fixtures", "node_modules", ".venv"}
+                 "graftshard_fixtures", "graftexport_fixtures",
+                 "node_modules", ".venv"}
 
 
 def collect_files(paths: Sequence[str],

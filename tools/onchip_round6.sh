@@ -287,6 +287,38 @@ step serve_chaos_r6 1800 python -m raft_tpu.cli.serve_bench \
     --breaker-backoff-ms 5000 --breaker-backoff-max-ms 600000 \
     --recover-s 300 --gather-ms 20 --log-dir /tmp/raft_serve_chaos_r6
 
+# ---- AOT executable cache: load-vs-compile cold-start A/B (PR 16) ----
+# the serialized-artifact seam against real-chip compile times: the
+# cold leg compiles both hot-path buckets and STORES their serialized
+# executables (summary: compiles=N, aot_misses=N); the warm leg is a
+# fresh process against the same dir and must report compiles=0,
+# aot_hits=N, compiles_avoided=N — the replica-rollout cold-start
+# number is the wall_s delta between the two legs (on-chip compiles
+# are minutes; the load is an I/O-bound deserialize). The chaos leg
+# re-runs the corruption drill against the warm dir: every round
+# corrupts the cached artifact before a recompiling bucket's load, and
+# the drill must exit clean (miss-and-recompile, entry re-stored).
+rm -rf /tmp/raft_aot_r6
+step serve_export_r6_cold 2400 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 24 --submitters 2 \
+    --bucket-batch 4 --deadline-ms 30000 --gather-ms 20 \
+    --wire u8 --aot-cache /tmp/raft_aot_r6 \
+    --log-dir /tmp/raft_serve_export_r6_cold
+step serve_export_r6 2400 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 24 --submitters 2 \
+    --bucket-batch 4 --deadline-ms 30000 --gather-ms 20 \
+    --wire u8 --aot-cache /tmp/raft_aot_r6 \
+    --log-dir /tmp/raft_serve_export_r6
+step serve_export_r6_chaos 2400 python -m raft_tpu.cli.serve_bench \
+    --shapes 368x496 --requests 24 --submitters 2 --bucket-batch 4 \
+    --chaos 2 --dispatch-timeout-ms 120000 --hang-ms 180000 \
+    --breaker-backoff-ms 5000 --breaker-backoff-max-ms 600000 \
+    --recover-s 300 --gather-ms 20 --aot-cache /tmp/raft_aot_r6
+# the production round trip at the envelope shape: store through
+# AOTCache, reload through the verified path, run, diff vs live jit
+# (bitwise pin) — the refactored export cycle check (VERDICT r2 #7)
+step export_cycle_r6 2400 python tools/export_cycle_check.py
+
 # ---- multi-model registry: basic+small mixed-priority drill (PR 9) ---
 # the two paper archs served side by side behind the ModelRegistry:
 # basic is the accurate live tier, small the fast tier, traffic split
