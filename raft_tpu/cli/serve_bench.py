@@ -184,7 +184,8 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
               ragged=False, capacity_classes=None,
               fault_plan=None, recover_s=0.0,
               metrics_path=None, trace_path=None, trace_sample=1.0,
-              tracer=None, seed=0, engine=None, aot_cache=None):
+              tracer=None, seed=0, engine=None, aot_cache=None,
+              replicas=1, replica_ceiling=None):
     """The drill as a library call (tests reuse it, and may pass a
     prebuilt warm-start ``engine`` to share compiles across drills).
     Returns the summary dict the CLI prints.
@@ -226,7 +227,16 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     them + the ledger counters). ``tracer`` injects a prebuilt ledger
     (the chaos harness shares ONE across rounds so trace ids stay
     unique in the shared file). Default off: summary byte-identical
-    to the untraced drill."""
+    to the untraced drill.
+
+    ``replicas`` > 1 (or a ``replica_ceiling``) arms the data-parallel
+    replica fleet (parallel/placement.py): the engine fans out into N
+    lanes warmed from the primary (AOT-loaded when ``aot_cache`` is
+    set — zero extra XLA compiles per added lane), micro-batches
+    dispatch least-loaded across them, and the summary grows a
+    ``fleet`` block with per-replica dispatches/occupancy/breaker
+    state/queue depth. At the default ``replicas=1`` the fleet is
+    never built and the summary is byte-identical to before."""
     import numpy as np
 
     from raft_tpu.serving.engine import RAFTEngine
@@ -282,7 +292,9 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                                 feature_cache_capacity=cache_capacity,
                                 ragged=ragged,
                                 metrics_path=metrics_path,
-                                tracer=tracer)
+                                tracer=tracer,
+                                replicas=replicas,
+                                replica_ceiling=replica_ceiling)
     if feature_cache and sessions:
         # compile-outside-the-measurement discipline (the engine's
         # envelope precompile, one layer up): the device forward-warp
@@ -475,6 +487,34 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
         "wall_s": round(wall, 3),
         "pairs_per_s": round(total_served / wall, 2) if wall else 0.0,
     }
+    fleet = health.get("fleet")
+    if fleet:
+        # replica-fleet surface (key absent at replicas=1 — the
+        # summary stays byte-identical to the single-engine drill):
+        # per-replica dispatch/occupancy/breaker/queue-depth blocks
+        # the serve_fleet_r6 rung A/Bs against serve_bench_r6
+        reps = rec.get("replicas") or {}
+        lanes = {}
+        for name, ln in sorted(fleet["lanes"].items()):
+            m = reps.get(name[1:], {})
+            lanes[name] = {
+                "active": ln["active"],
+                "quarantined": ln["quarantined"],
+                "dispatches": ln["dispatches"],
+                "completed": m.get("completed", 0),
+                "occupancy": m.get("occupancy", 0.0),
+                "queue_depth_last": m.get("queue_depth_last", 0),
+                "open_breakers": sum(
+                    1 for b in ln["breakers"].values()
+                    if b["state"] != "closed"),
+            }
+        summary["fleet"] = {
+            "replicas": fleet["replicas"],
+            "active": fleet["active"],
+            "ceiling": fleet["ceiling"],
+            "concurrency_max": fleet["concurrency_max"],
+            "lanes": lanes,
+        }
     aot = (engine.aot_stats() if hasattr(engine, "aot_stats")
            else {"enabled": 0})
     if aot.get("enabled"):
@@ -1429,6 +1469,19 @@ def main(argv=None):
                    help="interactive-only slice of the admission "
                         "budget (default N/4): batch-class traffic "
                         "can never take the last R tokens")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="data-parallel replica fleet: fan the engine "
+                        "out into N lanes behind one scheduler "
+                        "(parallel/placement.py); replicas 2..N warm "
+                        "from --aot-cache when set (zero extra XLA "
+                        "compiles per lane) and the summary grows a "
+                        "per-replica 'fleet' block. Default 1: no "
+                        "fleet, byte-identical summary")
+    p.add_argument("--replica-ceiling", type=int, default=None,
+                   metavar="M",
+                   help="autoscale bound: queue pressure may grow the "
+                        "fleet up to M lanes and idle lanes retire "
+                        "back toward the --replicas floor")
     p.add_argument("--aot-cache", default=None, metavar="DIR",
                    help="serialized-executable cache dir "
                         "(serving/aot.py): precompile LOADS artifacts "
@@ -1506,6 +1559,19 @@ def main(argv=None):
                          "would repeat trace ids) — trace the "
                          "single-model chaos or the plain registry "
                          "drill")
+    if args.replicas > 1 or args.replica_ceiling:
+        if args.models or args.chaos:
+            raise SystemExit(
+                "--replicas/--replica-ceiling drive the plain drill "
+                "only for now (registry rungs size their own fleets "
+                "via canary_fraction; the chaos harness stays "
+                "single-engine) — drop --models/--chaos")
+        if args.feature_cache:
+            raise SystemExit(
+                "--replicas with --feature-cache is not supported: "
+                "the device-resident feature pool is single-engine "
+                "state (a stream's cached activations live on ONE "
+                "replica's device) — run the fleet without it")
     if (args.guardian or args.admission_budget) and not args.models:
         raise SystemExit("--guardian/--admission-budget need --models "
                          "(they are ModelRegistry features)")
@@ -1668,7 +1734,8 @@ def main(argv=None):
         recover_s=args.recover_s,
         metrics_path=metrics_path, trace_path=trace_path,
         trace_sample=trace_sample, seed=args.seed,
-        aot_cache=args.aot_cache)
+        aot_cache=args.aot_cache,
+        replicas=args.replicas, replica_ceiling=args.replica_ceiling)
     print(json.dumps(summary), flush=True)
 
 

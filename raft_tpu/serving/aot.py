@@ -386,6 +386,94 @@ class AOTCache:
         except Exception:  # noqa: BLE001
             return False
 
+    # -- GC (the store must not only grow) ---------------------------------
+
+    def evict(self, max_bytes: Optional[int] = None,
+              max_age_s: Optional[float] = None,
+              weights: Optional[str] = None) -> Dict[str, int]:
+        """Garbage-collect entries; returns
+        ``{removed, removed_bytes, remaining, remaining_bytes}``.
+
+        Three independent policies, applied in this order:
+
+        - ``weights``: drop every entry whose key's weights fingerprint
+          matches — the registry's retirement hook (a retired variant's
+          artifacts are dead weight the moment no live/canary engine
+          shares its fingerprint).
+        - ``max_age_s``: drop entries whose manifest is older than this
+          many seconds.
+        - ``max_bytes``: after the above, drop OLDEST-first until the
+          store's blob bytes fit the budget (the entries most recently
+          stored — the ones a warm restart will want — survive).
+
+        Unparseable/torn entries (no manifest, bad JSON) already read
+        as a load miss; any size/age policy treats them as removable
+        garbage. Like :meth:`store`, eviction is best-effort: an
+        unremovable entry is skipped, never raised into serving."""
+        import time as _time
+
+        out = {"removed": 0, "removed_bytes": 0,
+               "remaining": 0, "remaining_bytes": 0}
+        if not os.path.isdir(self.objects):
+            return out
+        entries = []
+        for name in sorted(os.listdir(self.objects)):
+            edir = os.path.join(self.objects, name)
+            if not os.path.isdir(edir) or name.startswith(".tmp"):
+                continue
+            manifest = None
+            mpath = os.path.join(edir, _MANIFEST)
+            try:
+                with open(mpath, encoding="utf-8") as f:
+                    manifest = json.load(f)
+                size = int(manifest.get("blob_bytes", 0))
+                mtime = os.path.getmtime(mpath)
+            except Exception:  # noqa: BLE001 — torn entry: garbage
+                size = sum(
+                    os.path.getsize(os.path.join(edir, p))
+                    for p in os.listdir(edir)
+                    if os.path.isfile(os.path.join(edir, p)))
+                mtime = 0.0      # oldest possible: first to go
+            entries.append((edir, manifest, size, mtime))
+
+        def _drop(entry) -> None:
+            edir, _, size, _ = entry
+            shutil.rmtree(edir, ignore_errors=True)
+            if not os.path.isdir(edir):
+                out["removed"] += 1
+                out["removed_bytes"] += size
+
+        keep = []
+        for e in entries:
+            _, manifest, _, _ = e
+            key = (manifest or {}).get("key") or {}
+            if weights is not None and key.get("weights") == weights:
+                _drop(e)
+            else:
+                keep.append(e)
+        if max_age_s is not None:
+            cutoff = _time.time() - float(max_age_s)
+            fresh = []
+            for e in keep:
+                if e[3] < cutoff:
+                    _drop(e)
+                else:
+                    fresh.append(e)
+            keep = fresh
+        if max_bytes is not None:
+            total = sum(e[2] for e in keep)
+            for e in sorted(keep, key=lambda e: e[3]):   # oldest first
+                if total <= max_bytes:
+                    break
+                before = out["removed"]
+                _drop(e)
+                if out["removed"] > before:
+                    total -= e[2]
+                    keep.remove(e)
+        out["remaining"] = len(keep)
+        out["remaining_bytes"] = sum(e[2] for e in keep)
+        return out
+
     def stats(self) -> Dict[str, int]:
         return {"hits": self.hits, "misses": self.misses,
                 "stores": self.stores}

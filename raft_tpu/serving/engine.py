@@ -870,6 +870,73 @@ class RAFTEngine:
                 "compiles_avoided": self.aot_hits,
             }
 
+    # -- replica fleet (parallel/placement.py) ------------------------------
+
+    def spawn_replica(self) -> "RAFTEngine":
+        """A data-parallel sibling for the replica fleet: same config/
+        iters/wire/mode flags and the same weight tree, SHARING this
+        engine's AOT artifact store — so the sibling warms every bucket
+        by LOADING the serialized executable this engine already stored
+        (``aot_hits`` counts it; ``compile_count`` stays 0 per added
+        replica, the fleet's zero-compile pin). Without a store the
+        sibling adopts this engine's compiled executables directly
+        (:meth:`adopt_executables`) — still zero compiles for warm
+        buckets.
+
+        The sibling's signature tables mirror this engine's bucket/
+        class KEYS as ``precompile=False`` placeholders, so routing
+        (``route_bucket``/``route_ragged``) answers identically across
+        the fleet while the tables stay replica-LOCAL dicts — a wedge
+        verdict's ``drop_bucket`` on one replica never touches a
+        sibling's executable."""
+        with self._lock:
+            variables = self.variables
+            plain = list(self._compiled)
+            cached = list(self._compiled_cached)
+            ragged = list(self._compiled_ragged)
+        rep = RAFTEngine(
+            variables, self.config, iters=self.iters, envelope=(),
+            precompile=False, mesh=self.mesh,
+            exact_shapes=self.exact_shapes,
+            warm_start=self.warm_start, wire=self.wire,
+            feature_cache=self.feature_cache, ragged=self.ragged,
+            ragged_grain=self.ragged_grain, aot_cache=self._aot)
+        with rep._lock:
+            for s in plain:
+                rep._compiled.setdefault(s, None)
+            for s in cached:
+                rep._compiled_cached.setdefault(s, None)
+            for s in ragged:
+                rep._compiled_ragged.setdefault(s, None)
+        if self._aot is None:
+            rep.adopt_executables(self)
+        return rep
+
+    def adopt_executables(self, source: "RAFTEngine") -> int:
+        """Fill this engine's signature tables from ``source``'s
+        compiled executables (the no-artifact-store fallback for
+        :meth:`spawn_replica`). The TABLES stay this engine's own
+        dicts — ``drop_bucket`` here never affects ``source`` — while
+        the executable objects are shared (immutable once compiled;
+        XLA executables are safe to invoke from concurrent replicas).
+        Returns how many executables were adopted."""
+        with source._lock:
+            tables = (dict(source._compiled),
+                      dict(source._compiled_cached),
+                      dict(source._compiled_ragged))
+        n = 0
+        with self._lock:
+            for mine, theirs in zip((self._compiled,
+                                     self._compiled_cached,
+                                     self._compiled_ragged), tables):
+                for shape, exe in theirs.items():
+                    if exe is not None and mine.get(shape) is None:
+                        mine[shape] = exe
+                        n += 1
+                    else:
+                        mine.setdefault(shape, exe)
+        return n
+
     def _select_bucket(self, b: int, h: int, w: int,
                        cached: bool = False
                        ) -> Optional[Tuple[int, int, int]]:

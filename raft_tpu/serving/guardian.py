@@ -185,6 +185,33 @@ def window_stats(cur: Dict, base: Dict) -> Dict:
                           or {}).get("refs", [])}
         out["exemplars"] = [dict(e) for e in refs
                             if e["trace_id"] not in seen][-8:]
+    reps = cur.get("replicas")
+    if reps:
+        # replica-fleet variant: carry each lane's OWN window (counts
+        # subtracted against the baseline's same-lane block; a lane
+        # activated mid-bake subtracts zeros) AND the fleet-merged
+        # histogram p99. The merge is the honest aggregate; the
+        # per-lane windows are what _breaches judges so one sick
+        # replica cannot hide inside N-1 healthy ones.
+        base_reps = base.get("replicas") or {}
+        per, merged = {}, LatencyHistogram()
+        for k in sorted(reps):
+            cur_r, base_r = reps[k], base_reps.get(k)
+            base_counts = (base_r["latency"]["counts"]
+                           if base_r is not None
+                           else [0] * len(cur_r["latency"]["counts"]))
+            rh = LatencyHistogram()
+            rh.counts = [c - b for c, b in
+                         zip(cur_r["latency"]["counts"], base_counts)]
+            rh.count = sum(rh.counts)
+            rh.max = cur_r["latency"]["max_ms"]
+            merged.merge(rh)
+            done = (cur_r["completed"]
+                    - (base_r["completed"] if base_r is not None else 0))
+            per[str(k)] = {"requests": done, "completed": done,
+                           "p99_ms": rh.quantile(0.99)}
+        out["replicas"] = per
+        out["p99_merged_ms"] = merged.quantile(0.99)
     return out
 
 
@@ -284,6 +311,25 @@ class SLOGuardian:
         if can_w["breaker_opens"] > p.max_breaker_opens:
             out.append(f"breaker_opens {can_w['breaker_opens']} > "
                        f"{p.max_breaker_opens}")
+        # replica-fleet canary: judge each lane's OWN window against
+        # the SAME live-derived bound. The merged p99 already feeds
+        # can_w["p99_ms"]-style aggregates, but a breach confined to
+        # one replica of N dilutes 1/N in the merge — per-lane
+        # judgment is the anti-dilution guarantee (one sick replica
+        # with enough traffic rolls the canary back, however healthy
+        # its siblings look).
+        for rk, rw in sorted((can_w.get("replicas") or {}).items()):
+            if rw["requests"] < p.min_requests:
+                continue
+            if live_judgeable and rw["p99_ms"] > bound:
+                out.append(
+                    f"canary_replica_p99 r{rk} {rw['p99_ms']} > live "
+                    f"{live_w['p99_ms']} * {p.p99_ratio} + "
+                    f"{p.p99_slack_ms}")
+            if (p.p99_ceiling_ms is not None
+                    and rw["p99_ms"] > p.p99_ceiling_ms):
+                out.append(f"canary_replica_p99 r{rk} {rw['p99_ms']} "
+                           f"> ceiling {p.p99_ceiling_ms}")
         return out
 
     @staticmethod

@@ -225,6 +225,12 @@ class ServingMetrics:
         #: byte-identical to the untraced stack.
         self._exemplars = collections.deque(maxlen=64)
         self._tail_max_idx = -1
+        #: replica fleet (scheduler replicas>1): per-replica dispatch/
+        #: occupancy/latency blocks, created lazily by the first
+        #: ``replica=`` record. Single-engine serving never passes a
+        #: replica, the dict stays empty, and the snapshot schema is
+        #: byte-identical to the fleet-less stack.
+        self._replicas: Dict[int, Dict] = {}
 
     # -- recording --------------------------------------------------------
 
@@ -243,6 +249,19 @@ class ServingMetrics:
         self.depth_max = max(self.depth_max, depth)
         self._depth_sum += depth
         self._depth_samples += 1
+
+    def _replica(self, replica: Optional[int]) -> Optional[Dict]:
+        """The replica's fleet block, created on first use (caller
+        holds the lock). None replica records nothing per-replica."""
+        if replica is None:
+            return None
+        r = self._replicas.get(replica)
+        if r is None:
+            r = {"dispatches": 0, "filled": 0, "capacity": 0,
+                 "completed": 0, "queue_depth_last": 0,
+                 "latency": LatencyHistogram()}
+            self._replicas[replica] = r
+        return r
 
     def _prio(self, priority: Optional[str]) -> Optional[Dict]:
         """The class's counter block, created on first use (caller
@@ -317,13 +336,15 @@ class ServingMetrics:
     def record_dispatch(self, bucket: str, filled: int, capacity: int,
                         depth: int, real_px: int = 0,
                         padded_px: int = 0, ragged: bool = False,
-                        cross_shape: bool = False) -> None:
+                        cross_shape: bool = False,
+                        replica: Optional[int] = None) -> None:
         """``real_px``/``padded_px``: requested pixels vs the
         executable's padded pixels for this dispatch (the padding-waste
         gauge; 0/0 from duck-typed callers keeps the historical
         records). ``ragged``: a capacity-class dispatch;
         ``cross_shape``: it coalesced more than one distinct request
-        shape."""
+        shape. ``replica``: the fleet lane that ran it — feeds the
+        per-replica blocks (None = single-engine, no block)."""
         with self._lock:
             self.dispatches += 1
             b = self._bucket(bucket)
@@ -340,12 +361,19 @@ class ServingMetrics:
                 self.ragged_padded_px += padded_px
                 if cross_shape:
                     self.ragged_cross_shape += 1
+            r = self._replica(replica)
+            if r is not None:
+                r["dispatches"] += 1
+                r["filled"] += filled
+                r["capacity"] += capacity
+                r["queue_depth_last"] = depth
             self._depth(depth)
 
     def record_complete(self, bucket: str, queue_ms: float,
                         device_ms: float,
                         priority: Optional[str] = None,
-                        trace_id: Optional[str] = None) -> bool:
+                        trace_id: Optional[str] = None,
+                        replica: Optional[int] = None) -> bool:
         """Record one completion. ``trace_id`` (request tracing
         armed): the completion is judged against the latency
         histogram's top occupied bucket — returns True when it IS a
@@ -366,6 +394,10 @@ class ServingMetrics:
             if p is not None:
                 p["completed"] += 1
                 p["latency"].observe(total)
+            r = self._replica(replica)
+            if r is not None:
+                r["completed"] += 1
+                r["latency"].observe(total)
             if trace_id is None:
                 return False
             idx = self._latency.bucket_idx(total)
@@ -582,6 +614,25 @@ class ServingMetrics:
                     for key, b in sorted(self._buckets.items())
                 },
             }
+            if self._replicas:
+                # replica fleet armed: per-lane fan-out blocks (the
+                # balance/occupancy evidence the 2×-spread acceptance
+                # reads). Absent in single-engine mode: additive
+                # schema, byte-identical without a fleet.
+                rec["replicas"] = {
+                    str(k): {
+                        "dispatches": r["dispatches"],
+                        "filled": r["filled"],
+                        "capacity": r["capacity"],
+                        "occupancy": round(r["filled"] / r["capacity"],
+                                           4)
+                        if r["capacity"] else 0.0,
+                        "completed": r["completed"],
+                        "queue_depth_last": r["queue_depth_last"],
+                        "latency": r["latency"].snapshot(),
+                    }
+                    for k, r in sorted(self._replicas.items())
+                }
             if fcache is not None:
                 rec["feature_cache"] = fcache
             if self._exemplars:

@@ -291,6 +291,37 @@ class ModelRegistry:
         variant.final_snapshot = variant.scheduler.metrics.snapshot(
             executables=len(variant.engine._compiled))
         self._set_state(name, variant, MODEL_RETIRED)
+        self._retire_artifacts(name, variant)
+
+    def _retire_artifacts(self, name: str, variant: _Variant) -> None:
+        """AOT-store hygiene for a retired variant: evict its
+        serialized executables from the artifact cache UNLESS a
+        surviving variant (any model's live or canary) still serves
+        the same weights fingerprint — a rolled-back canary's blobs go,
+        the live engine's stay, and a shared-fingerprint re-deploy
+        keeps its warm path. Best-effort: a GC failure never fails the
+        rollout that triggered it."""
+        aot = getattr(variant.engine, "_aot", None)
+        fp = getattr(variant.engine, "_weights_fp", None)
+        if aot is None or fp is None or not hasattr(aot, "evict"):
+            return
+        with self._lock:
+            survivors = {
+                getattr(v.engine, "_weights_fp", None)
+                for m in self._models.values()
+                for v in (m.live, m.canary) if v is not None
+                and v is not variant}
+        if fp in survivors:
+            return
+        try:
+            gone = aot.evict(weights=fp)
+        except Exception:  # noqa: BLE001 — GC must not fail a rollout
+            return
+        if gone.get("removed"):
+            self._events.record_event(
+                "aot_evicted", model=name, version=variant.version,
+                removed=gone["removed"],
+                removed_bytes=gone["removed_bytes"])
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -395,6 +426,16 @@ class ModelRegistry:
                      and compat(variables))
         shapes = getattr(live.engine, "bucket_shapes",
                          lambda: sorted(live.engine._compiled))
+        # fleet-proportional canary: when the live variant runs N
+        # replica lanes, the canary defaults to its traffic share of
+        # the fleet (fraction * N, floor 1) — a 25% canary over a
+        # 4-lane live gets 1 lane, not 4 idle engines' worth of
+        # compiles. Explicit replicas= in sched_kw wins.
+        live_fleet = len(getattr(live.scheduler, "_lanes", ()) or ())
+        if live_fleet > 1:
+            sched_kw.setdefault(
+                "replicas",
+                max(1, round(canary_fraction * live_fleet)))
         try:
             variant = self._build_variant(
                 name, variables, cfg, version,
@@ -468,10 +509,18 @@ class ModelRegistry:
             m.canary_fraction = 0.0
             live = m.live
         if canary.same_arch:
-            # weight swap into the live engine: atomic wrt in-flight
-            # dispatches (the engine snapshots its tree per dispatch),
-            # executables reused — the cheap path PR-6 built
-            live.engine.update_weights(canary.engine.variables)
+            # weight swap through the live SCHEDULER: atomic wrt
+            # in-flight dispatches (the engine snapshots its tree per
+            # dispatch), executables reused — and when the live variant
+            # runs a replica fleet, swap_weights applies the new tree
+            # to every lane under one quiesced epoch (all-or-nothing:
+            # a lane that fails mid-swap rolls the already-swapped
+            # lanes back, so the fleet is never half-rolled)
+            swap = getattr(live.scheduler, "swap_weights", None)
+            if swap is not None:
+                swap(canary.engine.variables)
+            else:
+                live.engine.update_weights(canary.engine.variables)
             # feature-cache broom: every slot in the live pool was
             # computed by the OLD weights — stale canary-era features
             # must never feed the promoted model (streams re-prime;

@@ -54,7 +54,14 @@ Plan entries (a list of dicts, or ``{"faults": [...]}``):
     BEFORE the read (cached-artifact bit rot) and ``kind="raise"``
     fails inside the verification scope; the drilled contract for BOTH
     is a clean MISS-and-recompile — the engine never crashes, never
-    strands a future, and no corrupted artifact can serve traffic).
+    strands a future, and no corrupted artifact can serve traffic),
+    ``scheduler.swap`` (per-replica weight application inside the
+    fleet's quiesced swap epoch,
+    ``MicroBatchScheduler.swap_weights`` — fires before EACH lane's
+    ``update_weights``, so ``at=k`` models lane k-1 failing mid-fleet
+    and the drilled contract is all-or-nothing: the already-swapped
+    lanes roll back to the old tree and the error surfaces — a fleet
+    is never left half-rolled).
 ``at``
     1-based occurrence at which the entry becomes eligible (default 1).
     With the defaults below, each entry fires exactly once — the

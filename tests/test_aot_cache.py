@@ -312,6 +312,113 @@ class TestVerifiedLoad:
         assert aot.AOTCache(str(tmp_path))._entry_valid(edir, key)
 
 
+# -- artifact-store GC ----------------------------------------------------
+
+
+class TestEvict:
+    def _seed(self, root, n=3):
+        """Store ``n`` entries under distinct weights fingerprints,
+        mtimes staggered oldest-first (entry 0 oldest)."""
+        fn = jax.jit(lambda x: x * 2.0 + 1.0)
+        x = jnp.arange(8, dtype=jnp.float32)
+        with aot.fresh_compile():
+            lowered = fn.lower(x)
+            compiled = lowered.compile()
+        cache = aot.AOTCache(root)
+        keys = [_full_key(weights=f"w{i}" * 8) for i in range(n)]
+        dirs = []
+        now = __import__("time").time()
+        for i, key in enumerate(keys):
+            edir = cache.store(key, compiled, lowered=lowered, args=(x,))
+            assert edir is not None
+            mtime = now - 1000.0 + 100.0 * i
+            os.utime(os.path.join(edir, "manifest.json"),
+                     (mtime, mtime))
+            dirs.append(edir)
+        return cache, keys, dirs
+
+    def test_weights_policy_removes_only_matching(self, tmp_path):
+        cache, keys, dirs = self._seed(str(tmp_path))
+        out = cache.evict(weights=keys[1]["weights"])
+        assert out["removed"] == 1 and out["remaining"] == 2
+        assert out["removed_bytes"] > 0
+        assert not os.path.isdir(dirs[1])
+        assert os.path.isdir(dirs[0]) and os.path.isdir(dirs[2])
+        # the survivors still verify
+        assert cache._entry_valid(dirs[0], keys[0])
+
+    def test_max_age_removes_stale_entries(self, tmp_path):
+        cache, keys, dirs = self._seed(str(tmp_path))
+        # entries sit at ages ~1000s/900s/800s: cut at 850
+        out = cache.evict(max_age_s=850.0)
+        assert out["removed"] == 2 and out["remaining"] == 1
+        assert os.path.isdir(dirs[2])
+
+    def test_max_bytes_evicts_oldest_first(self, tmp_path):
+        cache, keys, dirs = self._seed(str(tmp_path))
+        per = os.path.getsize(os.path.join(dirs[0], "executable.bin"))
+        out = cache.evict(max_bytes=int(per * 1.5))
+        assert out["removed"] == 2
+        assert out["remaining"] == 1
+        assert out["remaining_bytes"] <= per * 1.5
+        # the NEWEST entry (the one a warm restart wants) survived
+        assert os.path.isdir(dirs[2])
+        assert not os.path.isdir(dirs[0]) and not os.path.isdir(dirs[1])
+
+    def test_torn_entry_reads_as_oldest_garbage(self, tmp_path):
+        cache, keys, dirs = self._seed(str(tmp_path))
+        torn = os.path.join(str(tmp_path), "objects", "deadbeef")
+        os.makedirs(torn)
+        with open(os.path.join(torn, "manifest.json"), "w",
+                  encoding="utf-8") as f:
+            f.write("{not json")
+        out = cache.evict(max_age_s=3600.0)
+        assert not os.path.isdir(torn)      # mtime 0.0: first to go
+        assert out["remaining"] == 3        # real entries untouched
+
+    def test_empty_store_is_a_noop(self, tmp_path):
+        out = aot.AOTCache(str(tmp_path)).evict(max_bytes=0)
+        assert out == {"removed": 0, "removed_bytes": 0,
+                       "remaining": 0, "remaining_bytes": 0}
+
+
+class TestRegistryRetirementGC:
+    def test_rollback_evicts_canary_artifacts_keeps_live(
+            self, small_setup, tmp_path):
+        """The registry half of the GC satellite: a rolled-back
+        canary's serialized executables leave the shared store with
+        it; the live fingerprint's artifacts stay (a warm restart
+        still loads them) and the eviction is an auditable event."""
+        cfg, variables = small_setup
+        adir = str(tmp_path / "artifacts")
+        mpath = str(tmp_path / "metrics.jsonl")
+        objs = os.path.join(adir, "objects")
+        reg = ModelRegistry(metrics_path=mpath, gather_window_s=0.0)
+        try:
+            reg.add_model("m", variables, cfg, iters=1,
+                          envelope=[(1, 32, 32)], artifact_dir=adir)
+            swapped = jax.tree_util.tree_map(lambda a: a + 1e-3,
+                                             variables)
+            reg.deploy("m", swapped, canary_fraction=0.5,
+                       artifact_dir=adir)
+            assert len(os.listdir(objs)) == 2
+            live = reg._models["m"].live.engine
+            reg.rollback("m")
+            remaining = os.listdir(objs)
+            assert len(remaining) == 1
+            with open(os.path.join(objs, remaining[0],
+                                   "manifest.json"),
+                      encoding="utf-8") as f:
+                survivor = json.load(f)["key"]["weights"]
+            assert survivor == live._weights_fp
+            events = [json.loads(line) for line in open(mpath)]
+            gone = [e for e in events
+                    if e.get("event") == "aot_evicted"]
+            assert len(gone) == 1 and gone[0]["removed"] == 1
+        finally:
+            reg.close()
+
+
 # -- the chaos surface ----------------------------------------------------
 
 

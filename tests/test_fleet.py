@@ -1,0 +1,383 @@
+"""Replica fleet acceptance (ISSUE 17): the placement layer's
+decisions (replicate-vs-shard, capacity fit, scaling policy), the
+fleet dispatcher's fan-out (concurrency > 1, least-loaded balance,
+queue-depth scale-up / idle retirement), the per-replica failure
+domain (one wedged replica quarantines alone while its siblings keep
+serving — zero stranded futures, accounting identity intact), the
+fleet-atomic weight swap (the ``scheduler.swap`` chaos site must
+never leave a half-rolled fleet), the construction-time
+``ConfigError`` contracts, and the real-engine pin: replicas 2..N
+warm from the shared AOT artifact store with ZERO extra XLA compiles
+and bitwise-identical flow vs the single-engine oracle."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_scheduler import _FakeEngine, _pad8, _wait_for
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import RAFT
+from raft_tpu.parallel.placement import SHARD_PX_THRESHOLD, Placement
+from raft_tpu.serving.engine import RAFTEngine
+from raft_tpu.serving.resilience import DispatchWedged
+from raft_tpu.serving.scheduler import ConfigError, MicroBatchScheduler
+from raft_tpu.testing import faults
+from raft_tpu.testing.faults import FaultInjected
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm()
+
+
+def _pair(rng, h=32, w=32):
+    return (rng.rand(h, w, 3).astype(np.float32) * 255,
+            rng.rand(h, w, 3).astype(np.float32) * 255)
+
+
+class _FleetEngine(_FakeEngine):
+    """The scheduler-facing fake, fleet-capable: ``spawn_replica``
+    mirrors the compiled-key table (the real engine's placeholder
+    contract) and ``update_weights`` records the tree for the
+    swap-epoch drills."""
+
+    def __init__(self, infer_delay_s=0.0, fetch_delay_s=0.0):
+        super().__init__(infer_delay_s, fetch_delay_s)
+        self.variables = {"gen": 0}
+        self.spawned = 0
+
+    def spawn_replica(self):
+        rep = _FleetEngine(self.infer_delay_s, self.fetch_delay_s)
+        rep._compiled = dict.fromkeys(self._compiled)
+        rep.variables = self.variables
+        self.spawned += 1
+        return rep
+
+    def update_weights(self, variables):
+        self.variables = variables
+
+
+# -- the placement layer ---------------------------------------------------
+
+
+class TestPlacement:
+    def test_replicate_is_default_without_mesh(self):
+        p = Placement(_FleetEngine(), replicas=2)
+        assert p.decide((32, 32)) == "replicate"
+        assert p.decide((2160, 3840)) == "replicate"   # no partitioner
+
+    def test_shard_for_4k_class_on_mesh_armed_primary(self):
+        eng = _FleetEngine()
+        eng.partitioner = object()
+        p = Placement(eng, replicas=2)
+        assert p.decide((32, 32)) == "replicate"
+        assert p.decide((2160, 3840)) == "shard"
+        assert 2160 * 3840 >= SHARD_PX_THRESHOLD
+
+    def test_spawn_builds_floor_and_assigns_devices(self):
+        eng = _FleetEngine()
+        eng.ensure_bucket(2, 32, 32)
+        p = Placement(eng, replicas=3)
+        assert len(p.engines) == 3 and p.engines[0] is eng
+        assert eng.spawned == 2
+        # replicas mirror the primary's bucket keys (routing parity)
+        for rep in p.engines[1:]:
+            assert set(rep._compiled) == set(eng._compiled)
+        snap = p.snapshot()
+        assert snap["replicas"] == 3 and snap["floor"] == 3
+        assert sorted(snap["assignments"]) == ["r0", "r1", "r2"]
+
+    def test_grow_stops_at_ceiling(self):
+        p = Placement(_FleetEngine(), replicas=1, ceiling=2)
+        p.grow()
+        assert len(p.engines) == 2
+        with pytest.raises(ValueError, match="ceiling"):
+            p.grow()
+
+    def test_engines_list_validation(self):
+        eng = _FleetEngine()
+        with pytest.raises(ValueError, match="primary first"):
+            Placement(eng, replicas=2, engines=[_FleetEngine(),
+                                                _FleetEngine()])
+        with pytest.raises(ValueError, match="entries"):
+            Placement(eng, replicas=2, engines=[eng])
+
+    def test_spawnless_engine_needs_explicit_list(self):
+        class Duck:
+            pass
+        with pytest.raises(ValueError, match="spawn_replica"):
+            Placement(Duck(), replicas=2)
+
+    def test_scaling_policy(self):
+        p = Placement(_FleetEngine(), replicas=1, ceiling=3)
+        assert p.want_scale_up(queue_depth=9, active=1, max_batch=4)
+        assert not p.want_scale_up(queue_depth=3, active=1, max_batch=4)
+        assert not p.want_scale_up(queue_depth=99, active=3, max_batch=4)
+        assert not p.want_retire(idle_s=99.0, active=1,
+                                 idle_retire_s=1.0)     # at the floor
+        p2 = Placement(_FleetEngine(), replicas=1, ceiling=3)
+        p2.grow()
+        assert p2.want_retire(idle_s=2.0, active=2, idle_retire_s=1.0)
+        assert not p2.want_retire(idle_s=0.5, active=2,
+                                  idle_retire_s=1.0)
+
+    def test_bucket_fit_matches_single_engine_path(self):
+        eng = _FleetEngine()
+        # cold: warms one bucket at max_batch, exactly _shape_capacity
+        assert Placement.bucket_fit(eng, (32, 32), 4) == 4
+        assert eng.compile_calls == 1
+        # warm: probes, no second compile
+        assert Placement.bucket_fit(eng, (32, 32), 4) == 4
+        assert eng.compile_calls == 1
+
+
+# -- the fleet dispatcher (fake engines: deterministic timing) -------------
+
+
+class TestFleetServing:
+    def _sched(self, eng, **kw):
+        kw.setdefault("gather_window_s", 0.0)
+        kw.setdefault("max_batch", 2)
+        return MicroBatchScheduler(eng, **kw)
+
+    def test_fanout_concurrency_and_balance(self, rng):
+        """The tentpole gauge: mixed-shape traffic over 4 replicas
+        shows dispatch concurrency > 1 and per-replica load within 2x
+        of each other (least-loaded pick)."""
+        eng = _FleetEngine(infer_delay_s=0.02)
+        sched = self._sched(eng, replicas=4, dispatch_timeout_s=10.0)
+        try:
+            futs = [sched.submit(*_pair(rng, *s))
+                    for s in [(32, 32), (40, 40)] * 20]
+            for f in futs:
+                assert f.result(timeout=60).flow.shape[-1] == 2
+            h = sched.health()
+            fleet = h["fleet"]
+            assert fleet["replicas"] == 4 and fleet["active"] == 4
+            assert fleet["concurrency_max"] > 1
+            loads = [blk["dispatches"]
+                     for blk in fleet["lanes"].values()]
+            assert min(loads) >= 1
+            assert max(loads) <= 2 * min(loads), loads
+            snap = sched.metrics.snapshot()
+            assert snap["submitted"] == 40 and snap["completed"] == 40
+            # per-replica metrics blocks rode into the snapshot
+            reps = snap["replicas"]
+            assert len(reps) == 4
+            assert sum(b["completed"] for b in reps.values()) == 40
+            occ = [b["occupancy"] for b in reps.values()]
+            assert min(occ) > 0
+            assert max(occ) <= 2 * min(occ), occ
+        finally:
+            sched.close()
+
+    def test_queue_pressure_grows_then_idle_retires(self, rng):
+        """replicas=1 with a ceiling: sustained queue depth activates
+        replicas up to the ceiling; idleness retires them back to the
+        floor (never the primary)."""
+        eng = _FleetEngine(infer_delay_s=0.05)
+        sched = self._sched(eng, replicas=1, replica_ceiling=3,
+                            max_batch=1, max_queue=64,
+                            replica_idle_retire_s=0.15)
+        try:
+            futs = [sched.submit(*_pair(rng)) for _ in range(30)]
+            for f in futs:
+                f.result(timeout=60)
+            h = sched.health()
+            assert h["fleet"]["replicas"] > 1          # grew
+            assert h["fleet"]["concurrency_max"] > 1   # and fanned out
+            assert _wait_for(
+                lambda: sched.health()["fleet"]["active"] == 1,
+                timeout=10.0), sched.health()["fleet"]
+        finally:
+            sched.close()
+
+    def test_wedged_replica_quarantines_alone_rest_serve(self, rng):
+        """The chaos round: one replica's dispatch hangs past the
+        watchdog. ONLY that lane is quarantined; its siblings keep
+        serving the queue; every future settles (zero stranded) and
+        the accounting identity holds."""
+        eng = _FleetEngine()
+        sched = self._sched(eng, replicas=3, max_batch=1,
+                            dispatch_timeout_s=0.3,
+                            breaker_failures=1)
+        try:
+            victim = sched._lanes[1].engine
+            orig = victim.infer_batch_async
+            armed = {"on": True}
+
+            def hang(i1, i2, **kw):
+                if armed.pop("on", None):
+                    time.sleep(3.0)
+                return orig(i1, i2, **kw)
+
+            victim.infer_batch_async = hang
+            futs = [sched.submit(*_pair(rng)) for _ in range(20)]
+            outs = [f.exception(timeout=60) for f in futs]  # all settle
+            failed = [e for e in outs if e is not None]
+            assert len(failed) >= 1
+            assert all(isinstance(e, DispatchWedged) for e in failed)
+            h = sched.health()
+            quarantined = [k for k, blk in h["fleet"]["lanes"].items()
+                           if blk["quarantined"]]
+            assert quarantined == ["r1"]
+            assert h["state"] == "degraded"
+            snap = sched.metrics.snapshot()
+            assert snap["completed"] == 20 - len(failed)
+            assert snap["submitted"] == (
+                snap["completed"] + snap["failed"]
+                + snap["deadline_missed"] + snap["cancelled"])
+            assert snap["resilience"]["wedged"] == 1
+        finally:
+            sched.close()
+
+    def test_swap_weights_is_fleet_atomic_under_fault(self, rng):
+        """The ``scheduler.swap`` chaos site at lane 2: the epoch
+        aborts, the already-swapped lane rolls BACK, and every engine
+        still serves the old tree — never a mixed fleet. Disarmed, the
+        same swap lands everywhere."""
+        eng = _FleetEngine()
+        sched = self._sched(eng, replicas=3)
+        try:
+            old = [lane.engine.variables for lane in sched._lanes]
+            assert len(set(map(id, old))) >= 1
+            new = {"gen": 1}
+            faults.arm([{"site": "scheduler.swap", "at": 2,
+                         "kind": "raise"}])
+            with pytest.raises(FaultInjected):
+                sched.swap_weights(new)
+            for lane, before in zip(sched._lanes, old):
+                assert lane.engine.variables is before
+            faults.disarm()
+            sched.swap_weights(new)
+            assert all(lane.engine.variables is new
+                       for lane in sched._lanes)
+            # the epoch left the fleet serviceable
+            assert sched.submit(*_pair(rng)).result(timeout=60)
+        finally:
+            sched.close()
+
+    def test_feature_cache_with_replicas_raises_config_error(self):
+        eng = _FleetEngine()
+        eng.feature_cache = True
+        with pytest.raises(ConfigError, match="replica"):
+            MicroBatchScheduler(eng, replicas=2, feature_cache=True)
+
+    def test_pipeline_depth_with_replicas_raises_config_error(self):
+        with pytest.raises(ConfigError, match="pipeline_depth"):
+            MicroBatchScheduler(_FleetEngine(), replicas=2,
+                                pipeline_depth=2)
+
+    def test_replicas_one_builds_no_fleet(self, rng):
+        """The migration pin: ``replicas=1`` (the default) constructs
+        NO placement and NO lanes — the single-engine path, bitwise
+        PR-16."""
+        sched = self._sched(_FleetEngine())
+        try:
+            assert sched.placement is None and sched._lanes == []
+            assert "fleet" not in sched.health()
+            assert sched.submit(*_pair(rng)).result(timeout=60)
+            assert "replicas" not in sched.metrics.snapshot()
+        finally:
+            sched.close()
+
+    def test_close_stops_every_lane_worker(self, rng):
+        before = set(threading.enumerate())
+        eng = _FleetEngine()
+        sched = self._sched(eng, replicas=3)
+        futs = [sched.submit(*_pair(rng)) for _ in range(6)]
+        for f in futs:
+            f.result(timeout=60)
+        sched.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            leaked = [t for t in threading.enumerate()
+                      if t not in before and t.is_alive()
+                      and t.name.startswith("MicroBatchScheduler")]
+            if not leaked:
+                break
+            time.sleep(0.05)
+        assert not leaked, leaked
+
+
+# -- the real engine: AOT-warmed replicas, bitwise oracle ------------------
+
+
+class TestFleetRealEngine:
+    def test_replicas_warm_zero_compiles_bitwise_oracle(self, tmp_path):
+        """Replicas 2..3 spin up against the primary's artifact store:
+        ZERO XLA compiles each (AOT counters, never timing), and every
+        replica's flow at bucket-batch-1 integer inputs is BITWISE the
+        single-engine oracle."""
+        cfg = RAFTConfig(small=True)
+        model = RAFT(cfg)
+        img = jnp.zeros((1, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+        rng = np.random.RandomState(3)
+        i1 = (rng.rand(32, 32, 3) * 255).round().astype(np.float32)
+        i2 = (rng.rand(32, 32, 3) * 255).round().astype(np.float32)
+
+        primary = RAFTEngine(variables, cfg, iters=1,
+                             envelope=[(1, 32, 32)], precompile=True,
+                             aot_cache=str(tmp_path / "artifacts"))
+        oracle = np.asarray(primary.infer_batch(i1[None], i2[None]))[0]
+
+        sched = MicroBatchScheduler(primary, replicas=3, max_batch=1,
+                                    gather_window_s=0.0)
+        try:
+            futs = [sched.submit(i1, i2) for _ in range(9)]
+            for f in futs:
+                flow = np.asarray(f.result(timeout=600).flow)
+                assert np.array_equal(flow, oracle)   # bitwise
+            lanes = sched.health()["fleet"]["lanes"]
+            assert all(blk["dispatches"] >= 1
+                       for blk in lanes.values()), lanes
+            for lane in sched._lanes[1:]:
+                s = lane.engine.aot_stats()
+                assert s["compiles"] == 0, s
+                assert s["aot_hits"] >= 1, s
+            assert primary.aot_stats()["compiles"] == 1
+        finally:
+            sched.close()
+
+
+class TestServeBenchFleet:
+    def test_run_drill_grows_fleet_block(self):
+        """serve_bench's drill at --replicas 2: the summary grows the
+        per-replica ``fleet`` block (dispatches / occupancy / breaker
+        state / queue depth per lane); the same drill at the default
+        replicas=1 stays byte-identical — no ``fleet`` key at all."""
+        cfg = RAFTConfig(small=True)
+        model = RAFT(cfg)
+        img = jnp.zeros((1, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+        engine = RAFTEngine(variables, cfg, iters=1,
+                            envelope=[(1, 32, 32)], precompile=True)
+        from raft_tpu.cli.serve_bench import run_drill
+
+        s = run_drill(variables, cfg, shapes=[(32, 32)], requests=8,
+                      submitters=2, bucket_batch=1,
+                      gather_window_s=0.0, engine=engine, replicas=2)
+        assert s["served"] == 8 and s["accounting_ok"]
+        fleet = s["fleet"]
+        assert fleet["replicas"] == 2 == fleet["active"]
+        assert sorted(fleet["lanes"]) == ["r0", "r1"]
+        for blk in fleet["lanes"].values():
+            assert {"active", "quarantined", "dispatches", "completed",
+                    "occupancy", "queue_depth_last",
+                    "open_breakers"} <= set(blk)
+            assert blk["open_breakers"] == 0
+        assert sum(b["completed"]
+                   for b in fleet["lanes"].values()) == 8
+        # the single-engine drill on the SAME engine: no fleet key
+        s1 = run_drill(variables, cfg, shapes=[(32, 32)], requests=4,
+                       submitters=1, bucket_batch=1,
+                       gather_window_s=0.0, engine=engine)
+        assert "fleet" not in s1

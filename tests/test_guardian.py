@@ -91,6 +91,38 @@ def _blk(completed=0, failed=0, bucket=3, wedged=0, opens=0,
     return d
 
 
+def _rep(completed=0, bucket=3):
+    """One per-replica metrics block (the fleet scheduler's snapshot
+    shape): ``completed`` samples all in histogram bucket ``bucket``."""
+    counts = [0] * _NB
+    counts[bucket] = completed
+    return {"completed": completed, "dispatches": completed,
+            "filled": completed, "capacity": completed,
+            "occupancy": 1.0, "queue_depth_last": 0,
+            "latency": {"counts": counts, "count": completed,
+                        "mean_ms": 0.0, "max_ms": float(completed)}}
+
+
+def _fleet_blk(reps, failed=0, model=None):
+    """A variant snapshot whose latency/completed aggregate the given
+    ``{replica: (completed, bucket)}`` lanes — the shape a fleet
+    scheduler's ServingMetrics emits."""
+    counts = [0] * _NB
+    total = 0
+    for done, bucket in reps.values():
+        counts[bucket] += done
+        total += done
+    d = {"completed": total, "failed": failed,
+         "latency": {"counts": counts, "max_ms": float(total)},
+         "resilience": {"wedged": 0,
+                        "breaker_transitions": {"open": 0}},
+         "replicas": {str(k): _rep(done, bucket)
+                      for k, (done, bucket) in reps.items()}}
+    if model is not None:
+        d["model"] = model
+    return d
+
+
 class _FakeRegistry:
     """The registry surface the guardian needs, scripted."""
 
@@ -129,6 +161,110 @@ class TestWindowStats:
         cur2["latency"]["counts"][10] = 5   # 5 new slow samples
         w2 = window_stats(cur2, base2)
         assert w2["p99_ms"] == _BOUNDS_MS[10]
+
+
+class TestReplicaFleetWindows:
+    """window_stats over fleet-scheduler snapshots: per-replica window
+    views plus the LatencyHistogram.merge'd aggregate."""
+
+    def test_per_replica_windows_and_merged_p99(self):
+        base = _fleet_blk({0: (100, 2), 1: (50, 2)})
+        cur = _fleet_blk({0: (130, 2), 1: (80, 2)})
+        w = window_stats(cur, base)
+        assert w["replicas"]["0"]["completed"] == 30
+        assert w["replicas"]["1"]["completed"] == 30
+        assert w["replicas"]["0"]["p99_ms"] == _BOUNDS_MS[2]
+        assert w["p99_merged_ms"] == _BOUNDS_MS[2]
+
+    def test_replica_absent_from_baseline_windows_from_zero(self):
+        """A lane activated mid-bake has no baseline block: its whole
+        history IS the window (zeros subtract)."""
+        base = _fleet_blk({0: (100, 2)})
+        cur = _fleet_blk({0: (120, 2), 1: (15, 10)})
+        w = window_stats(cur, base)
+        assert w["replicas"]["1"]["completed"] == 15
+        assert w["replicas"]["1"]["p99_ms"] == _BOUNDS_MS[10]
+        # the merged tail sees the new lane's slow samples
+        assert w["p99_merged_ms"] == _BOUNDS_MS[10]
+
+    def test_non_fleet_snapshot_grows_no_replica_keys(self):
+        w = window_stats(_blk(completed=10), _blk())
+        assert "replicas" not in w and "p99_merged_ms" not in w
+
+
+class TestReplicaDilutionDrill:
+    """The satellite-3 drill: a p99 breach confined to ONE replica of
+    a fleet canary must roll the canary back even when the merged
+    window dilutes the breach below the aggregate threshold."""
+
+    def _guardian(self, state):
+        reg = _FakeRegistry()
+        t = [0.0]
+        g = SLOGuardian(
+            reg,
+            GuardianPolicy(bake_window_s=100.0, min_requests=5,
+                           p99_ratio=1.5, p99_slack_ms=0.0),
+            clock=lambda: t[0], reader=lambda: state["snap"])
+        return g, reg, t
+
+    def test_one_sick_replica_rolls_back_despite_dilution(self):
+        state = {"snap": {"m": {
+            "live": _blk(),
+            "canary": _fleet_blk({0: (0, 2), 1: (0, 2)},
+                                 model="m@v2")}}}
+        g, reg, t = self._guardian(state)
+        g.tick()
+        t[0] = 3.0
+        # r0: 1000 fast samples. r1: 5 samples at bucket 10 — under
+        # 1% of the merged window, so the AGGREGATE p99 still reads
+        # the fast bucket (the dilution); only r1's own window shows
+        # the breach.
+        state["snap"] = {"m": {
+            "live": _blk(completed=1000, bucket=2),
+            "canary": _fleet_blk({0: (1000, 2), 1: (5, 10)},
+                                 model="m@v2")}}
+        out = g.tick()
+        assert len(out) == 1
+        ev = out[0]["evidence"]["canary"]
+        assert ev["p99_ms"] == _BOUNDS_MS[2]          # diluted
+        assert ev["replicas"]["1"]["p99_ms"] == _BOUNDS_MS[10]
+        assert out[0]["action"] == "rollback"
+        assert "canary_replica_p99 r1" in out[0]["reason"]
+        assert reg.actions == [("rollback", "m")]
+
+    def test_sick_replica_below_min_requests_holds(self):
+        """Too few samples on the slow lane: statistically
+        inadmissible — no verdict yet (the aggregate min_requests is
+        met, the lane's is not)."""
+        state = {"snap": {"m": {
+            "live": _blk(),
+            "canary": _fleet_blk({0: (0, 2), 1: (0, 2)},
+                                 model="m@v2")}}}
+        g, reg, t = self._guardian(state)
+        g.tick()
+        t[0] = 3.0
+        state["snap"] = {"m": {
+            "live": _blk(completed=1000, bucket=2),
+            "canary": _fleet_blk({0: (1000, 2), 1: (3, 10)},
+                                 model="m@v2")}}
+        assert g.tick() == []
+        assert reg.actions == []
+
+    def test_healthy_fleet_canary_promotes(self):
+        state = {"snap": {"m": {
+            "live": _blk(),
+            "canary": _fleet_blk({0: (0, 2), 1: (0, 2)},
+                                 model="m@v2")}}}
+        g, reg, t = self._guardian(state)
+        g.tick()
+        t[0] = 101.0
+        state["snap"] = {"m": {
+            "live": _blk(completed=1000, bucket=2),
+            "canary": _fleet_blk({0: (500, 2), 1: (480, 2)},
+                                 model="m@v2")}}
+        out = g.tick()
+        assert out[0]["action"] == "promote"
+        assert reg.actions == [("promote", "m")]
 
 
 class TestGuardianJudgment:

@@ -554,6 +554,80 @@ def _build_scheduler_coalesce():
     return build
 
 
+def _build_engine_fleet():
+    def build():
+        ensure_cpu()
+        import shutil
+        import tempfile
+
+        import numpy as np
+
+        from raft_tpu.serving.engine import RAFTEngine
+        from raft_tpu.serving.scheduler import MicroBatchScheduler
+
+        variables, cfg = _engine_weights()
+        h, w = _IMAGE_HW
+        store = tempfile.mkdtemp(prefix="graftaudit_fleet_aot_")
+        try:
+            # the replica-fleet recipe: the PRIMARY compiles its one
+            # bucket fresh and serializes it into the artifact store;
+            # replicas 2..4 spawn from it and must warm ENTIRELY from
+            # AOT loads — zero extra XLA compiles per added replica is
+            # the fleet's headline contract, pinned here on the
+            # engines' own counters (never timing)
+            primary = RAFTEngine(variables, cfg, iters=_ITERS,
+                                 envelope=[(1, h, w)], precompile=True,
+                                 aot_cache=store)
+            rng = np.random.RandomState(0)
+
+            def pair():
+                return (rng.rand(h, w, 3).astype(np.float32) * 255,
+                        rng.rand(h, w, 3).astype(np.float32) * 255)
+
+            with MicroBatchScheduler(primary, replicas=4, max_batch=1,
+                                     gather_window_s=0.0) as sched:
+                futs = [sched.submit(*pair()) for _ in range(12)]
+                for f in futs:
+                    assert f.result(timeout=600).flow is not None
+                lanes = sched.health()["fleet"]["lanes"]
+                assert len(lanes) == 4, f"fleet size: {sorted(lanes)}"
+                assert all(v["dispatches"] >= 1 for v in lanes.values()), \
+                    f"idle lane in a least-loaded fleet: {lanes}"
+                engines = [lane.engine for lane in sched._lanes]
+            stats = [e.aot_stats() for e in engines]
+            assert stats[0]["compiles"] == 1, \
+                f"primary compiled != 1 bucket: {stats[0]}"
+            for k, s in enumerate(stats[1:], start=1):
+                assert s["compiles"] == 0, \
+                    f"replica {k} compiled instead of AOT-loading: {s}"
+                assert s["aot_hits"] >= 1, \
+                    f"replica {k} never hit the artifact store: {s}"
+            per_lane = [len(e._compiled) for e in engines]
+            assert per_lane == [1, 1, 1, 1], \
+                f"per-replica executable counts drifted: {per_lane}"
+            # zero cross-replica leakage: each replica owns its table —
+            # dropping replica 1's bucket must not reach its siblings
+            bucket = next(iter(engines[1]._compiled))
+            engines[1].drop_bucket(bucket)
+            survivors = [len(e._compiled) for e in engines]
+            assert survivors == [1, 0, 1, 1], \
+                f"drop_bucket leaked across replicas: {survivors}"
+            texts = tuple(exe.as_text()
+                          for exe in engines[0]._compiled.values() if exe)
+            return CanaryResult(
+                observed_compiles=sum(per_lane),
+                detail=f"4-replica fleet at {h}x{w}: one bucket per "
+                       "replica (4 total), ONE fresh XLA compile — "
+                       "replicas 2..4 warmed from the AOT artifact "
+                       "store (compiles=0, aot_hits>=1 each); every "
+                       "lane dispatched; per-replica tables isolated "
+                       "(drop on one leaves siblings intact)",
+                hlo_texts=texts)
+        finally:
+            shutil.rmtree(store, ignore_errors=True)
+    return build
+
+
 def _build_registry_two_models():
     def build():
         ensure_cpu()
@@ -730,6 +804,20 @@ def build_targets() -> List[Target]:
             notes="u8 wire: uint8 executable params (no host-side "
                   "widening), bitwise parity vs f32 at integer "
                   "inputs, warm-start round-trip"),
+        Target(
+            name="engine_fleet",
+            kind="canary",
+            build=_build_engine_fleet(),
+            expect_compiles=4,     # one bucket per replica, 4 lanes —
+            #                        but only ONE of them is a fresh
+            #                        XLA compile; replicas 2..4 warm
+            #                        from the AOT artifact store
+            #                        (compiles=0, aot_hits>=1, asserted
+            #                        in the build on engine counters)
+            notes="replica-fleet fan-out: 4 lanes behind one "
+                  "scheduler, one executable per replica with zero "
+                  "XLA compiles past the primary (AOT-loaded) and no "
+                  "cross-replica table leakage"),
         Target(
             name="registry_two_models",
             kind="canary",

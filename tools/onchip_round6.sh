@@ -179,6 +179,25 @@ step serve_bench_r6 1800 python -m raft_tpu.cli.serve_bench \
     --bucket-batch 4 --sessions 2 --session-frames 4 \
     --deadline-ms 30000 --gather-ms 20 --log-dir /tmp/raft_serve_r6
 
+# ---- replica fleet: data-parallel fan-out A/B (PR 17) ----------------
+# serve_bench_r6's EXACT traffic again, fanned across a 4-replica
+# fleet behind the same scheduler (least-loaded dispatch, per-replica
+# breaker boards). Compare the two JSON lines: pairs_per_s (the
+# data-parallel win is THE number), dispatch_gap_* (the fleet overlaps
+# device time across lanes), and the fleet block's per-replica
+# dispatches/occupancy (balance within ~2x is the placement contract).
+# Replicas 2..4 warm from the AOT store the primary populates in this
+# same run — the summary's compiles must equal documented_buckets
+# (primary only; each added lane is an I/O-bound deserialize, the
+# replica-rollout cold-start story serve_export_r6 measures end-to-end).
+rm -rf /tmp/raft_aot_fleet_r6
+step serve_fleet_r6 2400 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 48 --submitters 2 \
+    --bucket-batch 4 --sessions 2 --session-frames 4 \
+    --deadline-ms 30000 --gather-ms 20 \
+    --replicas 4 --aot-cache /tmp/raft_aot_fleet_r6 \
+    --log-dir /tmp/raft_serve_fleet_r6
+
 # ---- request tracing: REAL tail exemplars + phase attribution (PR 14)
 # serve_bench_r6's traffic with the span ledger armed (full sampling —
 # this window wants every span): spans.jsonl lands beside the metrics,
