@@ -114,6 +114,12 @@ CHAOS_SITES_REGISTRY = CHAOS_SITES + ("registry.load",)
 #: raises or hangs mid-decision must strand nothing and leave routing
 #: exactly as it found it (the site fires before any registry mutation)
 CHAOS_SITES_GUARDIAN = CHAOS_SITES_REGISTRY + ("guardian.decide",)
+#: multi-host drills add the remote lanes' three surfaces: both wire
+#: directions (a corrupted/raised exchange must fail over or settle
+#: cleanly, never strand) and the heartbeat probe (missed beats walk
+#: the suspect->dead ladder and the verdict consequences fire)
+CHAOS_SITES_HOSTS = CHAOS_SITES + ("transport.send", "transport.recv",
+                                   "host.heartbeat")
 
 
 def chaos_plan(rng: random.Random, hang_s: float = 0.5,
@@ -185,7 +191,8 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
               fault_plan=None, recover_s=0.0,
               metrics_path=None, trace_path=None, trace_sample=1.0,
               tracer=None, seed=0, engine=None, aot_cache=None,
-              replicas=1, replica_ceiling=None):
+              replicas=1, replica_ceiling=None, hosts=0,
+              host_kill_one=False):
     """The drill as a library call (tests reuse it, and may pass a
     prebuilt warm-start ``engine`` to share compiles across drills).
     Returns the summary dict the CLI prints.
@@ -236,7 +243,22 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
     dispatch least-loaded across them, and the summary grows a
     ``fleet`` block with per-replica dispatches/occupancy/breaker
     state/queue depth. At the default ``replicas=1`` the fleet is
-    never built and the summary is byte-identical to before."""
+    never built and the summary is byte-identical to before.
+
+    ``hosts`` > 0 arms the multi-host fleet (serving/hosts.py): N
+    loopback host workers — each a ``HostWorker`` over an engine
+    spawned from the primary (AOT-loaded when ``aot_cache`` is set,
+    zero extra XLA compiles per host) — behind a ``HostFleet`` with
+    heartbeats, breakers and the failover path, admitted (artifact
+    push + prewarm) BEFORE any traffic. ``host_kill_one=True`` runs
+    the kill-one drill: after every submitter has queued its traffic,
+    host ``h0``'s transport is poisoned mid-drain — the missed-beat
+    ladder must verdict it dead, its lane quarantine, in-flight
+    batches fail over to survivors, and every request still settle
+    exactly once. The summary grows a ``hosts`` block (per-host
+    state/ready/beats/failovers/rejoins/push counters); at the
+    default ``hosts=0`` none of this is built and the summary is
+    byte-identical to before."""
     import numpy as np
 
     from raft_tpu.serving.engine import RAFTEngine
@@ -279,6 +301,41 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
         from raft_tpu.serving.trace import TraceLedger
         _fresh_trace_file(trace_path)
         tracer = TraceLedger(trace_path, sample_rate=trace_sample)
+    host_fleet = None
+    if hosts:
+        from raft_tpu.serving.aot import AOTCache
+        from raft_tpu.serving.hosts import HostFleet, HostWorker
+        from raft_tpu.serving.transport import LoopbackTransport
+        spawn = getattr(engine, "spawn_replica", None)
+        if spawn is None:
+            raise ValueError("hosts > 0 needs an engine with "
+                             "spawn_replica (the host workers wrap "
+                             "siblings of the primary)")
+        import tempfile
+        transports = {}
+        for k in range(hosts):
+            # each loopback worker gets its OWN artifact root: the
+            # admit-time push ships the primary's serialized
+            # executables there sha256-verified — the full protocol,
+            # even though the in-process sibling warms from the
+            # shared store
+            root = (tempfile.mkdtemp(prefix=f"raft_host_h{k}_")
+                    if aot_cache else None)
+            transports[f"h{k}"] = LoopbackTransport(
+                HostWorker(spawn(), aot_root=root), name=f"h{k}")
+        # short ladder: the kill-one drill must verdict the poisoned
+        # host DEAD well inside the drill's drain window; the huge
+        # reconnect backoff keeps the monitor from resurrecting the
+        # deliberately-killed host mid-assertion
+        host_fleet = HostFleet(
+            transports,
+            aot_cache=AOTCache(aot_cache) if aot_cache else None,
+            heartbeat_s=0.05, heartbeat_timeout_s=2.0,
+            suspect_after=1, dead_after=2,
+            reconnect_backoff_s=600.0, rng=random.Random(seed))
+        # admit BEFORE traffic: artifact push + prewarm gate the
+        # lanes — zero requests route until every host verified
+        host_fleet.admit_all()
     sched = MicroBatchScheduler(engine, max_queue=max_queue,
                                 max_batch=bucket_batch,
                                 gather_window_s=gather_window_s,
@@ -294,7 +351,8 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
                                 metrics_path=metrics_path,
                                 tracer=tracer,
                                 replicas=replicas,
-                                replica_ceiling=replica_ceiling)
+                                replica_ceiling=replica_ceiling,
+                                host_fleet=host_fleet)
     if feature_cache and sessions:
         # compile-outside-the-measurement discipline (the engine's
         # envelope precompile, one layer up): the device forward-warp
@@ -389,6 +447,13 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
             t.start()
         for t in threads:
             t.join()
+        if host_kill_one and host_fleet is not None:
+            # every submitter has queued its traffic; the queue is
+            # still draining — poisoning h0 HERE lands the dead-host
+            # verdict mid-traffic (deterministically after admission,
+            # deterministically before the drain completes), and the
+            # failover path must re-dispatch its in-flight batches
+            host_fleet.poison("h0")
         if recover_s > 0:
             recover_loop()
         # settle traffic before reading health: submit threads join as
@@ -515,6 +580,30 @@ def run_drill(variables, cfg, *, shapes, requests=32, submitters=2,
             "concurrency_max": fleet["concurrency_max"],
             "lanes": lanes,
         }
+    hf = health.get("hosts")
+    if hf:
+        # multi-host surface (key absent at hosts=0 — the summary
+        # stays byte-identical to the single-process drill): per-host
+        # liveness/failover/artifact-push blocks the serve_hosts_r6
+        # rung's kill-one drill asserts against
+        summary["hosts"] = {
+            "state": hf["state"],
+            "suspect_after": hf["suspect_after"],
+            "dead_after": hf["dead_after"],
+            "hosts": {
+                name: {
+                    "state": blk["state"],
+                    "ready": blk["ready"],
+                    "beats": blk["beats"],
+                    "missed_beats": blk["missed_beats"],
+                    "failovers": blk["failovers"],
+                    "rejoins": blk["rejoins"],
+                    "push_entries": blk["push_entries"],
+                    "push_bytes": blk["push_bytes"],
+                    "push_retries": blk["push_retries"],
+                    "breaker": blk["breaker"]["state"],
+                } for name, blk in sorted(hf["hosts"].items())},
+        }
     aot = (engine.aot_stats() if hasattr(engine, "aot_stats")
            else {"enabled": 0})
     if aot.get("enabled"):
@@ -568,7 +657,16 @@ def _round_violations(s: dict) -> list:
     if s["health_state"] == "healthy" and s["open_buckets"]:
         v.append("health says healthy with open breakers")
     if s["health_state"] == "degraded" and not s["open_buckets"]:
-        v.append("health says degraded with all breakers closed")
+        # a dead/suspect host or a quarantined fleet lane degrades
+        # health with every bucket breaker closed — that's the
+        # fleet's degradation, not a breaker-accounting bug
+        lanes = (s.get("fleet") or {}).get("lanes", {})
+        fleet_degraded = (
+            s.get("hosts", {}).get("state", "healthy") != "healthy"
+            or any(ln["quarantined"] or ln["open_breakers"]
+                   for ln in lanes.values()))
+        if not fleet_degraded:
+            v.append("health says degraded with all breakers closed")
     return v
 
 
@@ -584,7 +682,7 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                     ragged=False, capacity_classes=None,
                     deadline_s=None, seed=0, metrics_path=None,
                     trace_path=None, trace_sample=1.0, engine=None,
-                    aot_cache=None):
+                    aot_cache=None, hosts=0):
     """``rounds`` randomized fault rounds + one clean recovery round
     over ONE shared engine (dropped buckets recompile lazily across
     rounds), asserting the invariants after each. Returns the summary
@@ -611,7 +709,14 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
     miss-and-recompile (the same violations machinery pins it: no
     stranded futures, executables back at the documented count, and a
     corrupted entry is REPLACED on the re-store, proven by the clean
-    round loading it again)."""
+    round loading it again).
+
+    ``hosts`` > 0 runs every round with N loopback host lanes and
+    widens the fault vocabulary to ``CHAOS_SITES_HOSTS``: both wire
+    directions plus the heartbeat probe — corrupted exchanges must
+    settle cleanly (failover or a settled error, never a strand) and
+    heartbeat faults walk the missed-beat ladder, firing the verdict
+    consequences mid-round. The same invariants pin the outcome."""
     from raft_tpu.serving.engine import RAFTEngine
 
     if ragged and feature_cache:
@@ -683,9 +788,13 @@ def run_chaos_drill(variables, cfg, *, shapes, rounds=3, requests=12,
                   cache_capacity=cache_capacity,
                   ragged=ragged, capacity_classes=capacity_classes,
                   recover_s=recover_s, metrics_path=metrics_path,
-                  tracer=tracer, engine=engine)
+                  tracer=tracer, engine=engine, hosts=hosts,
+                  aot_cache=aot_cache)
     sites = (CHAOS_SITES_PIPELINED if pipeline_depth > 1
              else CHAOS_SITES)
+    if hosts:
+        sites = sites + ("transport.send", "transport.recv",
+                         "host.heartbeat")
     aot_armed = bool(getattr(engine, "aot_stats",
                              lambda: {"enabled": 0})().get("enabled"))
     for r in range(rounds):
@@ -1482,6 +1591,22 @@ def main(argv=None):
                    help="autoscale bound: queue pressure may grow the "
                         "fleet up to M lanes and idle lanes retire "
                         "back toward the --replicas floor")
+    p.add_argument("--hosts", type=int, default=0, metavar="N",
+                   help="multi-host fleet (serving/hosts.py): N "
+                        "loopback host workers behind the transport "
+                        "seam, admitted via artifact push + prewarm "
+                        "before traffic, heartbeat-monitored with "
+                        "failover; the summary grows a per-host "
+                        "'hosts' block. With --chaos the plans also "
+                        "draw transport.send / transport.recv / "
+                        "host.heartbeat. Default 0: no fleet, "
+                        "byte-identical summary")
+    p.add_argument("--hosts-kill-one", action="store_true",
+                   help="with --hosts: poison host h0 after "
+                        "submission while the queue drains — the "
+                        "kill-one drill (dead verdict, lane "
+                        "quarantine, failover to survivors, every "
+                        "request settled exactly once)")
     p.add_argument("--aot-cache", default=None, metavar="DIR",
                    help="serialized-executable cache dir "
                         "(serving/aot.py): precompile LOADS artifacts "
@@ -1572,6 +1697,28 @@ def main(argv=None):
                 "the device-resident feature pool is single-engine "
                 "state (a stream's cached activations live on ONE "
                 "replica's device) — run the fleet without it")
+    if args.hosts:
+        if args.models:
+            raise SystemExit(
+                "--hosts drives the single-model drills only for now "
+                "(the registry drill builds its engines internally) "
+                "— drop --models")
+        if args.ragged:
+            raise SystemExit(
+                "--hosts with --ragged is not supported: remote "
+                "lanes speak the bucketed engine surface (see "
+                "ROADMAP) — run the host drill without --ragged")
+        if args.feature_cache:
+            raise SystemExit(
+                "--hosts with --feature-cache is not supported: the "
+                "device-resident feature pool is single-engine state "
+                "— run the host drill without it")
+    if args.hosts_kill_one and not args.hosts:
+        raise SystemExit("--hosts-kill-one needs --hosts")
+    if args.hosts_kill_one and args.chaos:
+        raise SystemExit("--hosts-kill-one drives the plain drill "
+                         "(the chaos rounds inject their own host "
+                         "faults via the widened site vocabulary)")
     if (args.guardian or args.admission_budget) and not args.models:
         raise SystemExit("--guardian/--admission-budget need --models "
                          "(they are ModelRegistry features)")
@@ -1708,7 +1855,8 @@ def main(argv=None):
             ragged=args.ragged, capacity_classes=capacity_classes,
             max_queue=args.queue, seed=args.seed,
             metrics_path=metrics_path, trace_path=trace_path,
-            trace_sample=trace_sample, aot_cache=args.aot_cache)
+            trace_sample=trace_sample, aot_cache=args.aot_cache,
+            hosts=args.hosts)
         print(json.dumps(summary), flush=True)
         if summary["violations"]:
             raise SystemExit(1)
@@ -1735,7 +1883,8 @@ def main(argv=None):
         metrics_path=metrics_path, trace_path=trace_path,
         trace_sample=trace_sample, seed=args.seed,
         aot_cache=args.aot_cache,
-        replicas=args.replicas, replica_ceiling=args.replica_ceiling)
+        replicas=args.replicas, replica_ceiling=args.replica_ceiling,
+        hosts=args.hosts, host_kill_one=args.hosts_kill_one)
     print(json.dumps(summary), flush=True)
 
 

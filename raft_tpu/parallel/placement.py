@@ -90,6 +90,35 @@ class Placement:
         #: replica index -> nominal device label (round-robin)
         self.assignments: Dict[int, str] = {
             k: self._device_label(k) for k in range(len(self.engines))}
+        #: host name -> {"lane": index, "state": ...} for lanes that
+        #: live on REMOTE hosts (serving/hosts.py); empty at hosts=0
+        self.hosts: Dict[str, Dict] = {}
+
+    # -- multi-host lanes ---------------------------------------------------
+
+    def attach_host(self, name: str, engine) -> int:
+        """Append a remote host's engine as one more fleet lane (after
+        the local lanes, so local indices never move). The ceiling
+        grows with it — host lanes are extra capacity, not consumers
+        of the local-replica growth headroom. Returns the lane
+        index."""
+        k = len(self.engines)
+        self.engines.append(engine)
+        self.ceiling += 1
+        self.assignments[k] = f"host:{name}"
+        self.hosts[name] = {"lane": k, "state": "healthy"}
+        return k
+
+    def mark_host(self, name: str, state: str) -> None:
+        """Record a host's liveness verdict (``healthy``/``suspect``/
+        ``dead``) against its lane — the quarantine-on-the-placement-
+        layer half of a dead-host verdict."""
+        if name in self.hosts:
+            self.hosts[name]["state"] = state
+
+    def host_lane(self, name: str) -> Optional[int]:
+        h = self.hosts.get(name)
+        return None if h is None else h["lane"]
 
     # -- replica construction ---------------------------------------------
 
@@ -212,4 +241,7 @@ class Placement:
             "mesh": self.partitioner is not None,
             "assignments": {f"r{k}": v
                             for k, v in sorted(self.assignments.items())},
+            **({"hosts": {name: dict(h)
+                          for name, h in sorted(self.hosts.items())}}
+               if self.hosts else {}),
         }

@@ -35,6 +35,8 @@ from raft_tpu.serving.feature_cache import (FeatureCacheMiss,
 from raft_tpu.serving.futures import settle_future
 from raft_tpu.serving.guardian import (AdmissionBudget, GuardianPolicy,
                                        SLOGuardian)
+from raft_tpu.serving.hosts import (HostDead, HostFleet, HostWorker,
+                                    RemoteEngine)
 from raft_tpu.serving.metrics import LatencyHistogram, ServingMetrics
 from raft_tpu.serving.registry import (DeployError, ModelRegistry,
                                        RolloutInProgress, UnknownModel,
@@ -48,6 +50,8 @@ from raft_tpu.serving.scheduler import (PRIORITY_BATCH,
                                         ServeResult)
 from raft_tpu.serving.session import VideoSession
 from raft_tpu.serving.trace import TraceLedger
+from raft_tpu.serving.transport import (LoopbackTransport,
+                                        SocketTransport, TransportError)
 
 __all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX", "MicroBatchScheduler",
            "BackpressureError", "DeadlineExceeded", "SchedulerClosed",
@@ -58,4 +62,6 @@ __all__ = ["RAFTEngine", "SHAPE_ENVELOPE_LINUX", "MicroBatchScheduler",
            "canary_hash_fraction", "PRIORITY_INTERACTIVE",
            "PRIORITY_BATCH", "SLOGuardian", "GuardianPolicy",
            "AdmissionBudget", "settle_future", "FeatureCachePool",
-           "FeatureCacheMiss", "StaleFeatureError", "TraceLedger"]
+           "FeatureCacheMiss", "StaleFeatureError", "TraceLedger",
+           "HostFleet", "HostWorker", "HostDead", "RemoteEngine",
+           "LoopbackTransport", "SocketTransport", "TransportError"]

@@ -474,6 +474,71 @@ class AOTCache:
         out["remaining_bytes"] = sum(e[2] for e in keep)
         return out
 
+    # -- fleet distribution ------------------------------------------------
+
+    def push(self, transport, *, attempts: int = 4,
+             base_s: float = 0.25, max_s: float = 8.0,
+             rng=None, sleep=None) -> Dict[str, int]:
+        """Ship every valid entry to a joining host over the transport
+        seam (closes the ROADMAP "deploy PUSHES artifact dirs to
+        remote replicas" item).
+
+        Per entry: one ``put_artifact`` call carrying the manifest
+        bytes, the blob, and the blob's sha256. Verification is end to
+        end — the worker recomputes the hash before any byte lands in
+        its store, and the reply echoes the digest this side checks
+        again. Corruption in transit (the ``transport.send`` chaos
+        site) therefore reads as a clean ``TransportError`` and the
+        entry is re-pushed under ``utils/retry``'s jittered backoff —
+        at most ``attempts`` tries per entry before the push (and the
+        host's admission) fails. Torn/invalid local entries are
+        skipped, exactly as :meth:`load` would skip them.
+
+        Returns ``{"entries", "bytes", "retries"}``."""
+        from ..utils.retry import retry as _retry
+
+        out = {"entries": 0, "bytes": 0, "retries": 0}
+        if not os.path.isdir(self.objects):
+            return out
+        for name in sorted(os.listdir(self.objects)):
+            edir = os.path.join(self.objects, name)
+            mpath = os.path.join(edir, _MANIFEST)
+            bpath = os.path.join(edir, _BLOB)
+            if not (os.path.isdir(edir) and os.path.isfile(mpath)
+                    and os.path.isfile(bpath)):
+                continue
+            try:
+                with open(mpath, "rb") as f:
+                    manifest_bytes = f.read()
+                manifest = json.loads(manifest_bytes.decode("utf-8"))
+                with open(bpath, "rb") as f:
+                    blob = f.read()
+            except Exception:  # noqa: BLE001 — torn entry: skip
+                continue
+            sha = hashlib.sha256(blob).hexdigest()
+            if sha != manifest.get("sha256"):
+                continue   # locally corrupt: a load-miss, not pushable
+
+            def _send():
+                reply = transport.call("put_artifact", {
+                    "digest": name, "manifest": manifest_bytes,
+                    "blob": blob, "sha256": sha})
+                if reply.get("sha256") != sha:
+                    raise RuntimeError(
+                        f"artifact {name}: push ack digest mismatch")
+                return reply
+
+            kw = {"attempts": attempts, "base_s": base_s,
+                  "max_s": max_s, "rng": rng,
+                  "on_retry": lambda *a, **k: out.__setitem__(
+                      "retries", out["retries"] + 1)}
+            if sleep is not None:
+                kw["sleep"] = sleep
+            _retry(_send, **kw)
+            out["entries"] += 1
+            out["bytes"] += len(blob)
+        return out
+
     def stats(self) -> Dict[str, int]:
         return {"hits": self.hits, "misses": self.misses,
                 "stores": self.stores}

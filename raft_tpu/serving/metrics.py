@@ -231,6 +231,12 @@ class ServingMetrics:
         #: replica, the dict stays empty, and the snapshot schema is
         #: byte-identical to the fleet-less stack.
         self._replicas: Dict[int, Dict] = {}
+        #: multi-host fleet (scheduler host_fleet): per-host liveness/
+        #: failover/artifact-push blocks, created lazily by the first
+        #: ``record_host_*`` call. ``hosts=0`` records nothing — the
+        #: dict stays empty and the snapshot schema is byte-identical
+        #: to the single-host stack.
+        self._hosts: Dict[str, Dict] = {}
 
     # -- recording --------------------------------------------------------
 
@@ -262,6 +268,51 @@ class ServingMetrics:
                  "latency": LatencyHistogram()}
             self._replicas[replica] = r
         return r
+
+    def _host(self, name: str) -> Dict:
+        """The host's fleet block, created on first use (caller holds
+        the lock)."""
+        h = self._hosts.get(name)
+        if h is None:
+            h = {"state": "healthy", "ready": False, "missed_beats": 0,
+                 "failovers": 0, "requeued": 0, "zombie_drops": 0,
+                 "push_entries": 0, "push_bytes": 0, "push_retries": 0,
+                 "rejoins": 0}
+            self._hosts[name] = h
+        return h
+
+    def record_host_state(self, name: str, state: str, *,
+                          missed: int = 0, ready: bool = False) -> None:
+        with self._lock:
+            h = self._host(name)
+            h["state"] = state
+            h["missed_beats"] = int(missed)
+            h["ready"] = bool(ready)
+
+    def record_host_failover(self, name: str, *,
+                             requeued: int = 0) -> None:
+        with self._lock:
+            h = self._host(name)
+            h["failovers"] += 1
+            h["requeued"] += int(requeued)
+
+    def record_host_zombie_drop(self, name: str) -> None:
+        """A late answer from a verdicted-dead host was dropped
+        instead of settling an already-failed-over future."""
+        with self._lock:
+            self._host(name)["zombie_drops"] += 1
+
+    def record_host_push(self, name: str, *, entries: int = 0,
+                         bytes: int = 0, retries: int = 0) -> None:
+        with self._lock:
+            h = self._host(name)
+            h["push_entries"] += int(entries)
+            h["push_bytes"] += int(bytes)
+            h["push_retries"] += int(retries)
+
+    def record_host_rejoin(self, name: str) -> None:
+        with self._lock:
+            self._host(name)["rejoins"] += 1
 
     def _prio(self, priority: Optional[str]) -> Optional[Dict]:
         """The class's counter block, created on first use (caller
@@ -632,6 +683,16 @@ class ServingMetrics:
                         "latency": r["latency"].snapshot(),
                     }
                     for k, r in sorted(self._replicas.items())
+                }
+            if self._hosts:
+                # multi-host fleet armed: per-host liveness/failover/
+                # artifact-push evidence (the kill-drill acceptance
+                # reads host_dead counts + failovers + push bytes
+                # here). Absent with hosts=0: additive schema,
+                # byte-identical without remote lanes.
+                rec["hosts"] = {
+                    name: dict(h)
+                    for name, h in sorted(self._hosts.items())
                 }
             if fcache is not None:
                 rec["feature_cache"] = fcache

@@ -65,6 +65,18 @@ EVENT_FIELDS: Dict[str, frozenset] = {
     "model_promote": frozenset({"model", "version", "mode"}),
     "model_rollback": frozenset({"model", "version"}),
     "registry_closed": frozenset({"models"}),
+    # replica fleet (scheduler fleet mode — replicas>1 or host lanes)
+    "replica_quarantined": frozenset({"replica", "bucket"}),
+    "replica_activated": frozenset({"replica", "queue_depth"}),
+    "replica_retired": frozenset({"replica", "idle_s"}),
+    "replica_grow_failed": frozenset({"error"}),
+    "fleet_weights_swap": frozenset({"replicas"}),
+    # multi-host fleet (serving/hosts.py + scheduler._wedge_host)
+    "host_suspect": frozenset({"host", "missed"}),
+    "host_dead": frozenset({"host", "missed"}),
+    "host_rejoined": frozenset({"host", "push_entries", "push_bytes",
+                                "push_retries", "compiles"}),
+    "failover": frozenset({"host", "replica", "requeued"}),
     # SLO guardian (serving/guardian.py)
     "guardian_bake_start": frozenset({"model", "version",
                                       "bake_window_s"}),

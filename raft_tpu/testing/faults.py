@@ -61,7 +61,22 @@ Plan entries (a list of dicts, or ``{"faults": [...]}``):
     ``update_weights``, so ``at=k`` models lane k-1 failing mid-fleet
     and the drilled contract is all-or-nothing: the already-swapped
     lanes roll back to the old tree and the error surfaces — a fleet
-    is never left half-rolled).
+    is never left half-rolled), ``transport.send`` (inside
+    ``Transport.call`` before a request leaves for a remote host,
+    serving/transport.py — ``raise``/``hang``/``crash`` model a dead
+    or half-up network path, ``kind="corrupt"`` zero-fills the encoded
+    request IN TRANSIT via :func:`fault_data`, and the drilled
+    contract is a clean ``TransportError`` the caller retries: an
+    artifact push re-sends after sha256 verification fails, an infer
+    dispatch fails over — corruption never reaches a settle),
+    ``transport.recv`` (the reply side of the same seam —
+    ``kind="corrupt"`` smashes the reply bytes; same retry contract),
+    ``host.heartbeat`` (top of one heartbeat probe in
+    ``HostFleet.beat``, serving/hosts.py — ``raise`` models a lost
+    beat, ``hang`` a network path that stalls the prober; enough
+    consecutive misses walk the host healthy → suspect → dead and the
+    dead verdict quarantines its lanes + fails over its in-flight
+    batches).
 ``at``
     1-based occurrence at which the entry becomes eligible (default 1).
     With the defaults below, each entry fires exactly once — the
@@ -283,6 +298,23 @@ def fault_file(site: str, path: str) -> Optional[str]:
     with open(victim, "r+b") as fh:
         fh.write(b"\x00" * n if n else b"\x00")
     return victim
+
+
+def fault_data(site: str, payload: bytes) -> bytes:
+    """Corruption injection point for IN-TRANSIT bytes (the transport
+    seam's analog of :func:`fault_file`): returns ``payload``
+    zero-filled (size-preserving, same rationale as ``fault_file``)
+    when a ``kind="corrupt"`` entry for ``site`` fires, else the
+    payload untouched. Call sites place this on the encoded message
+    right before it crosses the host boundary — the drill models
+    damage the RECEIVER-side decode/verify must catch (undecodable
+    request, sha256 mismatch on an artifact blob), and the drilled
+    contract is always a clean error the sender retries."""
+    if _PLAN is None:
+        return payload
+    if _match(site, ("corrupt",)) is None:
+        return payload
+    return b"\x00" * len(payload) if payload else b"\x00"
 
 
 # a process launched with a plan in its environment is armed on first

@@ -1,0 +1,126 @@
+"""Subprocess body for the multi-host fleet drills: one
+:class:`~raft_tpu.serving.hosts.HostWorker` served over the socket
+transport (:func:`~raft_tpu.serving.transport.serve_forever`), the
+reference worker process behind ``SocketTransport``.
+
+Two modes:
+
+- ``--stub`` (tier-1 cheap): a deterministic numpy stub engine — no
+  jax, no compiles; outputs are a pure function of the inputs so the
+  parent computes the bitwise oracle itself.
+- ``--weights W.pkl --aot-root DIR`` (the real-stack kill drill): the
+  engine is built LAZILY at ``prewarm`` time — after the parent's
+  ``AOTCache.push`` has landed verified artifacts under ``--aot-root``
+  — as a real ``RAFTEngine(aot_cache=..., precompile=True)``, so the
+  joining host warms by LOADING pushed executables: the ``prewarm``
+  reply's counters pin ZERO XLA compiles.
+
+Prints ``PORT <n>`` on stdout once bound (``--port 0`` = ephemeral);
+the parent reads it to build the transport. The parent SIGKILLs this
+process mid-batch in the crash drill — there is no graceful shutdown
+path on purpose.
+"""
+
+import argparse
+import pickle
+import sys
+
+import numpy as np
+
+from raft_tpu.serving.hosts import HostWorker
+from raft_tpu.serving.transport import serve_forever
+
+
+def _pad8(x):
+    return -(-x // 8) * 8
+
+
+class StubEngine:
+    """Deterministic scheduler-facing engine: flow = per-pixel
+    (i1 - i2) of the first two channels, scaled — a pure function of
+    the inputs, so any process (parent oracle, either host) produces
+    BITWISE-identical output. ``infer_delay_s`` widens the in-flight
+    window the kill drill aims at."""
+
+    warm_start = False
+    wire = "f32"
+
+    def __init__(self, infer_delay_s: float = 0.0):
+        self.infer_delay_s = float(infer_delay_s)
+        self._compiled = {}
+
+    def bucket_capacity(self, h, w):
+        fits = [s[0] for s in self._compiled
+                if s[1] == _pad8(h) and s[2] == _pad8(w)]
+        return max(fits) if fits else None
+
+    def ensure_bucket(self, b, h, w):
+        shape = (b, _pad8(h), _pad8(w))
+        self._compiled[shape] = object()
+        return shape
+
+    def route_bucket(self, b, h, w):
+        return (b, _pad8(h), _pad8(w))
+
+    def drop_bucket(self, shape):
+        return self._compiled.pop(shape, None) is not None
+
+    def executable_count(self):
+        return len(self._compiled)
+
+    def infer_batch(self, i1, i2, **kw):
+        if self.infer_delay_s:
+            import time
+
+            time.sleep(self.infer_delay_s)
+        i1 = np.asarray(i1, np.float32)
+        i2 = np.asarray(i2, np.float32)
+        return ((i1 - i2)[..., :2] * 0.125).astype(np.float32)
+
+
+def _real_factory(weights_path: str, aot_root: str, iters: int,
+                  h: int, w: int):
+    def build():
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.serving.engine import RAFTEngine
+
+        with open(weights_path, "rb") as fh:
+            variables = pickle.load(fh)
+        cfg = RAFTConfig(small=True)
+        # precompile over the envelope: with the pushed artifacts in
+        # place every lower/compile is an AOT LOAD (aot_hits), pinned
+        # by the prewarm reply's compiles==0
+        return RAFTEngine(variables, cfg, iters=iters,
+                          envelope=[(1, h, w)], precompile=True,
+                          aot_cache=aot_root)
+    return build
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--stub", action="store_true")
+    ap.add_argument("--infer-delay-s", type=float, default=0.0)
+    ap.add_argument("--weights")
+    ap.add_argument("--aot-root")
+    ap.add_argument("--iters", type=int, default=1)
+    ap.add_argument("--height", type=int, default=32)
+    ap.add_argument("--width", type=int, default=32)
+    args = ap.parse_args(argv)
+
+    if args.stub:
+        worker = HostWorker(StubEngine(args.infer_delay_s),
+                            aot_root=args.aot_root)
+    else:
+        if not (args.weights and args.aot_root):
+            ap.error("real mode needs --weights and --aot-root")
+        worker = HostWorker(
+            engine_factory=_real_factory(args.weights, args.aot_root,
+                                         args.iters, args.height,
+                                         args.width),
+            aot_root=args.aot_root)
+    serve_forever(args.port, worker, ready_fh=sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

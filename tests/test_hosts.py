@@ -1,0 +1,673 @@
+"""Multi-host fleet acceptance (ISSUE 18): the transport seam (wire
+protocol, corruption -> clean ``TransportError``, the length guard),
+the worker contracts (pre-warm-before-traffic gating, sha256-verified
+idempotent artifact push), ``AOTCache.push`` retrying a corrupted
+transfer into a byte-identical landing, the missed-beat liveness
+ladder (injectable clock: healthy -> suspect -> dead, verdict notice
+queued exactly once, breaker-paced reconnect -> full rejoin protocol),
+the scheduler's failover discipline over loopback host lanes (dead
+host's in-flight batch re-dispatches to survivors; every future
+settles exactly once, accounting identity intact, results bitwise),
+the ``hosts=0`` bitwise-PR-17 pin, and the real drills: SIGKILL one of
+two subprocess workers mid-traffic (stub stack and the real
+RAFTEngine/AOT-push stack), with the restarted worker rejoining via
+verified artifact push and ZERO XLA compiles."""
+
+import json
+import os
+import pickle
+import random
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.host_worker import StubEngine
+from tests.test_scheduler import _wait_for
+
+from raft_tpu.serving.aot import AOTCache
+from raft_tpu.serving.hosts import (HOST_DEAD, HOST_HEALTHY,
+                                    HOST_SUSPECT, HostFleet, HostWorker)
+from raft_tpu.serving.metrics import ServingMetrics
+from raft_tpu.serving.scheduler import ConfigError, MicroBatchScheduler
+from raft_tpu.serving.transport import (MAX_MESSAGE_BYTES, _LEN,
+                                        LoopbackTransport,
+                                        SocketTransport, TransportError,
+                                        _recv_msg, serve_forever)
+from raft_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm()
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _pairs(n, seed=0, h=32, w=32):
+    rs = np.random.RandomState(seed)
+    return [(rs.rand(h, w, 3).astype(np.float32) * 255,
+             rs.rand(h, w, 3).astype(np.float32) * 255)
+            for _ in range(n)]
+
+
+def _stub_oracle(a, b):
+    return ((a - b)[..., :2] * 0.125).astype(np.float32)
+
+
+def _events(mpath):
+    if not os.path.exists(mpath):
+        return []
+    return [json.loads(line)["event"] for line in open(mpath)
+            if json.loads(line).get("kind") == "serving_event"]
+
+
+def _accounting_ok(snap):
+    return snap["submitted"] == (snap["completed"] + snap["failed"]
+                                 + snap["deadline_missed"]
+                                 + snap["cancelled"])
+
+
+def _host_lane_block(sched, name):
+    for blk in sched.health()["fleet"]["lanes"].values():
+        if blk.get("host") == name:
+            return blk
+    raise AssertionError(f"no lane carries host {name}")
+
+
+# -- the transport seam ----------------------------------------------------
+
+
+class TestTransport:
+    def test_loopback_roundtrip_error_close_reopen(self):
+        t = LoopbackTransport(HostWorker(StubEngine()))
+        r = t.call("ping")
+        assert r == {"seq": 1, "ready": True}
+        # worker-side exceptions come back as clean error replies
+        with pytest.raises(TransportError, match="worker error"):
+            t.call("definitely_not_a_method")
+        t.close()
+        assert t.closed
+        with pytest.raises(TransportError, match="closed"):
+            t.call("ping")
+        # reopen targets the SAME worker object (state preserved)
+        assert t.reopen().call("ping")["seq"] == 2
+
+    def test_send_corruption_reads_as_transport_error(self):
+        t = LoopbackTransport(HostWorker(StubEngine()))
+        faults.arm([{"site": "transport.send", "kind": "corrupt",
+                     "count": 1}])
+        with pytest.raises(TransportError, match="corrupted"):
+            t.call("ping")
+        # exhausted plan: the retry is clean
+        assert t.call("ping")["ready"] is True
+
+    def test_recv_corruption_reads_as_transport_error(self):
+        t = LoopbackTransport(HostWorker(StubEngine()))
+        faults.arm([{"site": "transport.recv", "kind": "corrupt",
+                     "count": 1}])
+        with pytest.raises(TransportError, match="corrupted"):
+            t.call("ping")
+        assert t.call("ping")["ready"] is True
+
+    def test_length_guard_rejects_corrupt_prefix(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(_LEN.pack(MAX_MESSAGE_BYTES + 1))
+            with pytest.raises(TransportError, match="length"):
+                _recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_socket_transport_roundtrip(self):
+        ready = _ReadyPort()
+        threading.Thread(
+            target=serve_forever, args=(0, HostWorker(StubEngine())),
+            kwargs={"ready_fh": ready}, daemon=True).start()
+        assert ready.evt.wait(10.0)
+        t = SocketTransport("127.0.0.1", ready.port, call_timeout_s=10)
+        try:
+            assert t.call("ping")["ready"] is True
+            assert t.call("stats")["ready"] is True
+            with pytest.raises(TransportError, match="worker error"):
+                t.call("nope")
+            # the stream survived the error reply: still usable
+            assert t.call("ping")["seq"] == 2
+        finally:
+            t.close()
+
+
+class _ReadyPort:
+    """serve_forever's ready_fh hook, captured to an event."""
+
+    def __init__(self):
+        self.evt = threading.Event()
+        self.port = None
+
+    def write(self, s):
+        self.port = int(s.split()[1])
+
+    def flush(self):
+        self.evt.set()
+
+
+# -- the worker: pre-warm gating + verified artifact push ------------------
+
+
+class TestHostWorker:
+    def test_prewarm_gates_all_traffic(self):
+        t = LoopbackTransport(HostWorker(
+            engine_factory=lambda: StubEngine()))
+        assert t.call("ping")["ready"] is False
+        for method, payload in [
+                ("capacity", {"h": 32, "w": 32}),
+                ("infer", {"image1": np.zeros((1, 32, 32, 3),
+                                              np.float32),
+                           "image2": np.zeros((1, 32, 32, 3),
+                                              np.float32)})]:
+            with pytest.raises(TransportError, match="not prewarmed"):
+                t.call(method, payload)
+        stats = t.call("prewarm")
+        assert stats["compiles"] == 0
+        assert t.call("ping")["ready"] is True
+        flow = t.call("infer",
+                      {"image1": np.ones((1, 32, 32, 3), np.float32),
+                       "image2": np.zeros((1, 32, 32, 3), np.float32)})
+        assert flow.shape == (1, 32, 32, 2)
+
+    def _artifact(self, n=256, seed=7):
+        import hashlib
+
+        blob = np.random.RandomState(seed).bytes(n)
+        sha = hashlib.sha256(blob).hexdigest()
+        manifest = {"format": "test", "key": {"k": 1}, "sha256": sha,
+                    "blob_bytes": n}
+        return blob, sha, json.dumps(manifest).encode("utf-8")
+
+    def test_put_artifact_verifies_before_any_byte_lands(self, tmp_path):
+        w = HostWorker(StubEngine(), aot_root=str(tmp_path / "aot"))
+        blob, sha, mb = self._artifact()
+        with pytest.raises(ValueError, match="mismatch"):
+            w.handle("put_artifact",
+                     {"digest": "d0", "blob": blob, "manifest": mb,
+                      "sha256": "0" * 64})
+        with pytest.raises(ValueError, match="disagree"):
+            w.handle("put_artifact",
+                     {"digest": "d0", "blob": blob, "sha256": sha,
+                      "manifest": json.dumps(
+                          {"sha256": "f" * 64}).encode("utf-8")})
+        # nothing landed from the rejected pushes
+        assert not os.path.exists(
+            os.path.join(w.aot_root, "objects", "d0"))
+        reply = w.handle("put_artifact",
+                         {"digest": "d0", "blob": blob, "manifest": mb,
+                          "sha256": sha})
+        assert reply == {"sha256": sha, "bytes": len(blob)}
+        edir = os.path.join(w.aot_root, "objects", "d0")
+        assert open(os.path.join(edir, "executable.bin"),
+                    "rb").read() == blob
+        assert open(os.path.join(edir, "manifest.json"),
+                    "rb").read() == mb
+        # idempotent re-push (the retry-after-corruption path)
+        assert w.handle("put_artifact",
+                        {"digest": "d0", "blob": blob, "manifest": mb,
+                         "sha256": sha}) == reply
+
+    def test_aot_push_retries_corruption_into_identical_bytes(
+            self, tmp_path):
+        src = AOTCache(str(tmp_path / "src"))
+        blob, sha, mb = self._artifact(n=512)
+        edir = os.path.join(src.objects, "d" + "0" * 63)
+        os.makedirs(edir)
+        with open(os.path.join(edir, "executable.bin"), "wb") as fh:
+            fh.write(blob)
+        with open(os.path.join(edir, "manifest.json"), "wb") as fh:
+            fh.write(mb)
+        # a torn entry (no manifest) must be skipped, never shipped
+        os.makedirs(os.path.join(src.objects, "torn"))
+        with open(os.path.join(src.objects, "torn", "executable.bin"),
+                  "wb") as fh:
+            fh.write(b"half")
+        w = HostWorker(StubEngine(), aot_root=str(tmp_path / "dst"))
+        t = LoopbackTransport(w)
+        faults.arm([{"site": "transport.send", "kind": "corrupt",
+                     "count": 1}])
+        out = src.push(t, attempts=3, base_s=0.0,
+                       rng=random.Random(0), sleep=lambda s: None)
+        assert out == {"entries": 1, "bytes": len(blob), "retries": 1}
+        got = os.path.join(w.aot_root, "objects", "d" + "0" * 63)
+        assert open(os.path.join(got, "executable.bin"),
+                    "rb").read() == blob
+        assert open(os.path.join(got, "manifest.json"),
+                    "rb").read() == mb
+        assert not os.path.exists(
+            os.path.join(w.aot_root, "objects", "torn"))
+
+
+# -- the liveness ladder (injectable clock, no sleeping) -------------------
+
+
+class TestHeartbeatLadder:
+    def _fleet(self, transports, mpath=None, **kw):
+        kw.setdefault("heartbeat_s", 1.0)
+        kw.setdefault("suspect_after", 2)
+        kw.setdefault("dead_after", 4)
+        kw.setdefault("reconnect_backoff_s", 4.0)
+        kw.setdefault("rng", random.Random(0))
+        metrics = ServingMetrics(mpath) if mpath else None
+        return HostFleet(transports, metrics=metrics, **kw)
+
+    def test_ladder_verdict_once_and_breaker_paced_rejoin(
+            self, tmp_path):
+        mpath = str(tmp_path / "metrics.jsonl")
+        clock = _Clock()
+        t = LoopbackTransport(HostWorker(StubEngine()))
+        fleet = self._fleet({"h0": t}, mpath, clock=clock)
+        fleet.admit_all()
+        h = fleet.hosts["h0"]
+        assert h.ready and fleet.degradation() == "healthy"
+        assert fleet.beat("h0") and h.beats == 1
+
+        t.close()
+        assert fleet.beat_all() == ["h0"]          # miss 1
+        assert h.state == HOST_HEALTHY
+        fleet.beat("h0")                           # miss 2 -> suspect
+        assert h.state == HOST_SUSPECT
+        fleet.beat("h0")                           # miss 3
+        fleet.beat("h0")                           # miss 4 -> dead
+        assert h.state == HOST_DEAD and not h.ready
+        assert fleet.pop_notices() == [("dead", "h0")]
+        assert fleet.pop_notices() == []           # verdict queued ONCE
+        assert fleet.beat_all() == []              # dead hosts skipped
+        assert fleet.degradation() == "partitioned"
+        ev = _events(mpath)
+        assert ev.count("host_suspect") == 1
+        assert ev.count("host_dead") == 1
+
+        # reconnect is PACED: inside the breaker backoff, no probe
+        fleet.tick()
+        assert h.state == HOST_DEAD and h.rejoins == 0
+        # backoff expired (half-open): reopen -> ping -> full rejoin
+        clock.advance(1000.0)
+        fleet.tick()
+        assert h.state == HOST_HEALTHY and h.ready and h.rejoins == 1
+        assert fleet.pop_notices() == [("rejoined", "h0")]
+        assert "host_rejoined" in _events(mpath)
+        assert fleet.degradation() == "healthy"
+        health = fleet.health()
+        assert health["state"] == "healthy"
+        assert health["hosts"]["h0"]["rejoins"] == 1
+
+    def test_suspect_recovers_on_clean_beat(self):
+        clock = _Clock()
+        t = LoopbackTransport(HostWorker(StubEngine()))
+        fleet = self._fleet({"h0": t}, clock=clock)
+        fleet.admit_all()
+        # transient heartbeat faults (the host.heartbeat chaos site)
+        faults.arm([{"site": "host.heartbeat", "kind": "raise",
+                     "count": 2}])
+        fleet.beat("h0")
+        fleet.beat("h0")
+        h = fleet.hosts["h0"]
+        assert h.state == HOST_SUSPECT and h.missed == 2
+        assert fleet.beat("h0")                    # plan exhausted
+        assert h.state == HOST_HEALTHY and h.missed == 0
+        assert not fleet.pop_notices()             # never verdicted
+
+    def test_degradation_states_across_two_hosts(self):
+        clock = _Clock()
+        t0 = LoopbackTransport(HostWorker(StubEngine()))
+        t1 = LoopbackTransport(HostWorker(StubEngine()))
+        fleet = self._fleet([t0, t1], clock=clock, suspect_after=1,
+                            dead_after=2)
+        fleet.admit_all()
+        assert fleet.degradation() == "healthy"
+        t0.close()
+        fleet.beat("h0")
+        fleet.beat("h0")
+        assert fleet.hosts["h0"].state == HOST_DEAD
+        assert fleet.degradation() == "degraded"   # h1 still serves
+        t1.close()
+        fleet.beat("h1")
+        fleet.beat("h1")
+        assert fleet.degradation() == "partitioned"
+
+    def test_threshold_validation(self):
+        t = LoopbackTransport(HostWorker(StubEngine()))
+        with pytest.raises(ValueError, match="suspect_after"):
+            HostFleet([t], suspect_after=3, dead_after=3)
+
+
+# -- scheduler integration: loopback failover drill ------------------------
+
+
+class TestFleetFailoverLoopback:
+    def _stack(self, mpath, reconnect_backoff_s=600.0):
+        local = StubEngine()
+        t0 = LoopbackTransport(HostWorker(StubEngine(0.02)), name="h0")
+        t1 = LoopbackTransport(HostWorker(StubEngine(0.02)), name="h1")
+        fleet = HostFleet(
+            {"h0": t0, "h1": t1}, heartbeat_s=0.05,
+            heartbeat_timeout_s=0.5, suspect_after=1, dead_after=2,
+            reconnect_backoff_s=reconnect_backoff_s,
+            rng=random.Random(0))
+        fleet.admit_all()
+        sched = MicroBatchScheduler(
+            local, max_batch=2, gather_window_s=0.0,
+            dispatch_timeout_s=10.0, breaker_failures=2,
+            metrics_path=mpath, host_fleet=fleet)
+        return sched, fleet, t0
+
+    def test_dead_host_fails_over_all_futures_settle_bitwise(
+            self, tmp_path):
+        mpath = str(tmp_path / "metrics.jsonl")
+        sched, fleet, t0 = self._stack(mpath)
+        try:
+            pairs = _pairs(30)
+            futs = []
+            for i, (a, b) in enumerate(pairs):
+                futs.append(sched.submit(a, b))
+                if i == 9:
+                    fleet.poison("h0")   # kill mid-traffic
+            for (a, b), f in zip(pairs, futs):
+                flow = np.asarray(f.result(timeout=60).flow)
+                assert np.array_equal(flow, _stub_oracle(a, b))
+            assert _wait_for(
+                lambda: fleet.hosts["h0"].state == HOST_DEAD, 10.0)
+            assert _wait_for(
+                lambda: _host_lane_block(sched, "h0")["quarantined"],
+                10.0)
+            h = sched.health()
+            assert h["state"] == "degraded"
+            assert h["hosts"]["state"] == "degraded"
+            assert h["hosts"]["hosts"]["h0"]["state"] == "dead"
+            assert h["hosts"]["hosts"]["h1"]["state"] == "healthy"
+            assert _host_lane_block(sched, "h1")["active"]
+
+            snap = sched.metrics.snapshot()
+            assert snap["submitted"] == 30 == snap["completed"]
+            assert snap["failed"] == 0
+            assert snap["abandoned_inflight"] == 0   # zero stranded
+            assert _accounting_ok(snap)
+            ev = _events(mpath)
+            assert "host_dead" in ev
+            assert "failover" in ev
+            assert "replica_quarantined" in ev
+            assert snap["hosts"]["h0"]["state"] == "dead"
+
+            # explicit rejoin over a fresh transport to the SAME
+            # worker: full protocol, lane reactivates, serves again
+            fleet.rejoin("h0", t0.reopen())
+            assert _wait_for(
+                lambda: _host_lane_block(sched, "h0")["active"],
+                10.0)
+            futs2 = [sched.submit(a, b) for a, b in pairs[:8]]
+            for (a, b), f in zip(pairs, futs2):
+                assert np.array_equal(np.asarray(f.result(60).flow),
+                                      _stub_oracle(a, b))
+            assert fleet.hosts["h0"].rejoins == 1
+            assert "host_rejoined" in _events(mpath)
+            assert _wait_for(
+                lambda: sched.health()["state"] == "healthy", 10.0)
+        finally:
+            sched.close()
+
+    def test_hosts_zero_is_bitwise_pr17(self, tmp_path):
+        """The migration pin: no fleet -> no hosts surface at all."""
+        sched = MicroBatchScheduler(StubEngine(), gather_window_s=0.0)
+        try:
+            assert sched.host_fleet is None
+            assert "hosts" not in sched.health()
+            a, b = _pairs(1)[0]
+            flow = np.asarray(sched.submit(a, b).result(60).flow)
+            assert np.array_equal(flow, _stub_oracle(a, b))
+            assert "hosts" not in sched.metrics.snapshot()
+        finally:
+            sched.close()
+
+    def test_ragged_with_host_fleet_raises_config_error(self):
+        eng = StubEngine()
+        eng.ragged = True
+        t = LoopbackTransport(HostWorker(StubEngine()))
+        fleet = HostFleet({"h0": t})
+        with pytest.raises(ConfigError, match="host_fleet"):
+            MicroBatchScheduler(eng, ragged=True, host_fleet=fleet)
+
+
+# -- subprocess workers: the SIGKILL crash drill ---------------------------
+
+
+def _spawn_stub_worker(infer_delay_s=0.05):
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "host_worker.py"),
+         "--stub", "--infer-delay-s", str(infer_delay_s)],
+        cwd=REPO, stdout=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+    return proc, _read_port(proc)
+
+
+def _read_port(proc, timeout=120.0):
+    out = []
+
+    def _read():
+        out.append(proc.stdout.readline())
+
+    th = threading.Thread(target=_read, daemon=True)
+    try:
+        th.start()
+        th.join(timeout)
+        assert out and out[0].startswith("PORT "), \
+            f"worker never reported a port: {out!r}"
+        return int(out[0].split()[1])
+    finally:
+        if th.is_alive():
+            proc.kill()        # EOF unblocks the pending readline
+            th.join(5)
+
+
+class TestSubprocessKillDrill:
+    def test_sigkill_one_of_two_workers_failover_then_rejoin(
+            self, tmp_path):
+        """The acceptance drill on real processes and sockets (stub
+        engines: deterministic, jax-free math): SIGKILL one of two
+        subprocess workers mid-traffic -> its lane quarantines with
+        ``host_dead`` + ``failover`` in metrics.jsonl, every in-flight
+        request settles exactly once (bitwise, accounting identity,
+        zero stranded), and a RESTARTED worker rejoins through a new
+        transport and takes traffic again."""
+        mpath = str(tmp_path / "metrics.jsonl")
+        procs = {}
+        sched = None
+        try:
+            procs["h0"], p0 = _spawn_stub_worker()
+            procs["h1"], p1 = _spawn_stub_worker()
+            fleet = HostFleet(
+                {"h0": SocketTransport("127.0.0.1", p0,
+                                       call_timeout_s=30, name="h0"),
+                 "h1": SocketTransport("127.0.0.1", p1,
+                                       call_timeout_s=30, name="h1")},
+                heartbeat_s=0.05, heartbeat_timeout_s=1.0,
+                suspect_after=1, dead_after=2,
+                reconnect_backoff_s=600.0, rng=random.Random(0))
+            fleet.admit_all()
+            sched = MicroBatchScheduler(
+                StubEngine(), max_batch=2, gather_window_s=0.0,
+                dispatch_timeout_s=30.0, breaker_failures=2,
+                metrics_path=mpath, host_fleet=fleet)
+            pairs = _pairs(30)
+            futs = []
+            for i, (a, b) in enumerate(pairs):
+                futs.append(sched.submit(a, b))
+                if i == 9:
+                    procs["h0"].kill()             # SIGKILL mid-batch
+            for (a, b), f in zip(pairs, futs):
+                flow = np.asarray(f.result(timeout=120).flow)
+                assert np.array_equal(flow, _stub_oracle(a, b))
+            assert _wait_for(
+                lambda: fleet.hosts["h0"].state == HOST_DEAD, 20.0)
+            assert _wait_for(
+                lambda: _host_lane_block(sched, "h0")["quarantined"],
+                20.0)
+            snap = sched.metrics.snapshot()
+            assert snap["submitted"] == 30 == snap["completed"]
+            assert snap["failed"] == 0
+            assert snap["abandoned_inflight"] == 0
+            assert _accounting_ok(snap)
+            ev = _events(mpath)
+            assert "host_dead" in ev and "failover" in ev
+
+            # restart the worker (fresh process, NEW port) and rejoin
+            procs["h0b"], p0b = _spawn_stub_worker()
+            fleet.rejoin("h0", SocketTransport("127.0.0.1", p0b,
+                                               call_timeout_s=30,
+                                               name="h0"))
+            assert fleet.hosts["h0"].rejoins == 1
+            assert "host_rejoined" in _events(mpath)
+            futs2 = [sched.submit(a, b) for a, b in pairs[:10]]
+            for (a, b), f in zip(pairs, futs2):
+                assert np.array_equal(np.asarray(f.result(120).flow),
+                                      _stub_oracle(a, b))
+            assert _wait_for(
+                lambda: sched.health()["state"] == "healthy", 20.0)
+        finally:
+            if sched is not None:
+                sched.close()
+            for p in procs.values():
+                p.kill()
+                p.wait(timeout=10)
+
+
+# -- the real stack: AOT push, zero-compile prewarm, bitwise oracle --------
+
+
+class TestRealStackKillDrill:
+    def test_push_prewarm_kill_failover_rejoin_zero_compiles(
+            self, tmp_path):
+        """ISSUE 18 acceptance end to end on the REAL stack: the
+        parent's artifact store ships to two subprocess RAFTEngine
+        workers (sha256-verified), both prewarm with ZERO XLA compiles
+        (pure AOT loads), remote flow is bitwise the single-engine
+        oracle, a SIGKILL mid-traffic fails over with every request
+        settling exactly once, and the restarted worker rejoins
+        through a verified re-push — again zero compiles."""
+        import jax
+        import jax.numpy as jnp
+
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.models import RAFT
+        from raft_tpu.serving.engine import RAFTEngine
+
+        cfg = RAFTConfig(small=True)
+        model = RAFT(cfg)
+        img = jnp.zeros((1, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+        rs = np.random.RandomState(3)
+        i1 = (rs.rand(32, 32, 3) * 255).round().astype(np.float32)
+        i2 = (rs.rand(32, 32, 3) * 255).round().astype(np.float32)
+
+        art = str(tmp_path / "artifacts")
+        primary = RAFTEngine(variables, cfg, iters=1,
+                             envelope=[(1, 32, 32)], precompile=True,
+                             aot_cache=art)
+        oracle = np.asarray(primary.infer_batch(i1[None], i2[None]))[0]
+        wpath = str(tmp_path / "weights.pkl")
+        with open(wpath, "wb") as fh:
+            pickle.dump(variables, fh)
+
+        mpath = str(tmp_path / "metrics.jsonl")
+        procs = {}
+        sched = None
+        try:
+            def spawn(tag):
+                proc = subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(REPO, "tests", "host_worker.py"),
+                     "--weights", wpath,
+                     "--aot-root", str(tmp_path / f"aot_{tag}"),
+                     "--iters", "1", "--height", "32", "--width", "32"],
+                    cwd=REPO, stdout=subprocess.PIPE, text=True,
+                    env={**os.environ, "JAX_PLATFORMS": "cpu",
+                         "PYTHONPATH": REPO})
+                return proc, _read_port(proc)
+
+            procs["h0"], p0 = spawn("h0")
+            procs["h1"], p1 = spawn("h1")
+            fleet = HostFleet(
+                {"h0": SocketTransport("127.0.0.1", p0,
+                                       call_timeout_s=300, name="h0"),
+                 "h1": SocketTransport("127.0.0.1", p1,
+                                       call_timeout_s=300, name="h1")},
+                aot_cache=AOTCache(art), heartbeat_s=0.1,
+                heartbeat_timeout_s=5.0, suspect_after=1, dead_after=2,
+                reconnect_backoff_s=600.0, rng=random.Random(0))
+            stats = fleet.admit_all()
+            for name in ("h0", "h1"):
+                assert stats[name]["compiles"] == 0, stats[name]
+                assert stats[name]["aot_hits"] >= 1, stats[name]
+                assert fleet.hosts[name].push_entries >= 1
+                assert fleet.hosts[name].push_bytes > 0
+
+            sched = MicroBatchScheduler(
+                primary, max_batch=1, gather_window_s=0.0,
+                dispatch_timeout_s=120.0, breaker_failures=1,
+                metrics_path=mpath, host_fleet=fleet)
+            futs = []
+            for i in range(16):
+                futs.append(sched.submit(i1, i2))
+                if i == 5:
+                    procs["h0"].kill()             # SIGKILL mid-batch
+            for f in futs:
+                flow = np.asarray(f.result(timeout=600).flow)
+                assert np.array_equal(flow, oracle)   # bitwise
+            assert _wait_for(
+                lambda: fleet.hosts["h0"].state == HOST_DEAD, 30.0)
+            assert _wait_for(
+                lambda: _host_lane_block(sched, "h0")["quarantined"],
+                30.0)
+            snap = sched.metrics.snapshot()
+            assert snap["submitted"] == 16 == snap["completed"]
+            assert snap["failed"] == 0
+            assert snap["abandoned_inflight"] == 0
+            assert _accounting_ok(snap)
+            ev = _events(mpath)
+            assert "host_dead" in ev and "failover" in ev
+
+            # restart on a fresh port: full rejoin protocol — re-push
+            # (idempotent on the worker) + prewarm, again ZERO compiles
+            procs["h0b"], p0b = spawn("h0b")
+            rstats = fleet.rejoin(
+                "h0", SocketTransport("127.0.0.1", p0b,
+                                      call_timeout_s=300, name="h0"))
+            assert rstats["compiles"] == 0, rstats
+            assert rstats["aot_hits"] >= 1, rstats
+            assert "host_rejoined" in _events(mpath)
+            futs2 = [sched.submit(i1, i2) for _ in range(6)]
+            for f in futs2:
+                assert np.array_equal(
+                    np.asarray(f.result(timeout=600).flow), oracle)
+            assert _wait_for(
+                lambda: sched.health()["state"] == "healthy", 30.0)
+        finally:
+            if sched is not None:
+                sched.close()
+            for p in procs.values():
+                p.kill()
+                p.wait(timeout=10)

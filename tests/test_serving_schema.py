@@ -27,9 +27,11 @@ from raft_tpu.serving.resilience import DispatchWedged
 from raft_tpu.serving.scheduler import MicroBatchScheduler
 from raft_tpu.serving.trace import TraceLedger
 from raft_tpu.testing import faults
+from tests.host_worker import StubEngine
+from tests.test_fleet import _FleetEngine
 from tests.test_guardian import _FakeRegistry, _blk
 from tests.test_registry import _WarmFakeEngine
-from tests.test_scheduler import _FakeEngine
+from tests.test_scheduler import _FakeEngine, _wait_for
 
 Z = np.zeros((32, 32, 3), np.float32)
 
@@ -73,6 +75,67 @@ def _drive_scheduler_events(mpath, spath):
             time.sleep(0.05)
     sched.flush_feature_cache("drill")    # cache_flush event
     sched.close(drain=True)               # snapshot + span flush
+
+
+def _drive_fleet_and_host_events(mpath):
+    """replica_activated / replica_retired / replica_grow_failed /
+    fleet_weights_swap via a pressure-grown local fleet, then
+    replica_quarantined / host_suspect / host_dead / failover /
+    host_rejoined via a loopback host lane killed mid-traffic and
+    rejoined — the real emitters, never synthetic records."""
+    from raft_tpu.serving.hosts import HostFleet, HostWorker
+    from raft_tpu.serving.transport import LoopbackTransport
+
+    # queue pressure grows a replica (replica_activated), idleness
+    # retires it (replica_retired), and the swap epoch stamps
+    # fleet_weights_swap
+    sched = MicroBatchScheduler(
+        _FleetEngine(infer_delay_s=0.05), replicas=1,
+        replica_ceiling=2, max_batch=1, gather_window_s=0.0,
+        replica_idle_retire_s=0.1, metrics_path=mpath)
+    for f in [sched.submit(Z, Z) for _ in range(12)]:
+        f.result(timeout=30)
+    sched.swap_weights({"gen": 1})
+    assert _wait_for(
+        lambda: sched.health()["fleet"]["active"] == 1, timeout=10.0)
+    sched.close()
+
+    # a fleet whose scale-up can't build a replica: replica_grow_failed
+    class _NoGrow(_FleetEngine):
+        def spawn_replica(self):
+            raise RuntimeError("no replica budget")
+
+    sched2 = MicroBatchScheduler(
+        _NoGrow(infer_delay_s=0.05), replicas=1, replica_ceiling=2,
+        max_batch=1, gather_window_s=0.0, metrics_path=mpath)
+    for f in [sched2.submit(Z, Z) for _ in range(12)]:
+        f.result(timeout=30)
+    sched2.close()
+
+    # one loopback host killed mid-traffic: the missed-beat ladder
+    # (host_suspect -> host_dead), the verdict consequences
+    # (replica_quarantined + failover), then the explicit rejoin
+    t0 = LoopbackTransport(HostWorker(StubEngine(0.02)), name="h0")
+    fleet = HostFleet({"h0": t0}, heartbeat_s=0.05,
+                      heartbeat_timeout_s=0.5, suspect_after=1,
+                      dead_after=2, reconnect_backoff_s=600.0,
+                      rng=random.Random(0))
+    fleet.admit_all()
+    sched3 = MicroBatchScheduler(
+        StubEngine(), max_batch=2, gather_window_s=0.0,
+        breaker_failures=1, dispatch_timeout_s=10.0,
+        metrics_path=mpath, host_fleet=fleet)
+    futs = [sched3.submit(Z, Z) for _ in range(6)]
+    fleet.poison("h0")
+    for f in futs:
+        f.result(timeout=30)
+    assert _wait_for(
+        lambda: any(blk.get("host") == "h0" and blk["quarantined"]
+                    for blk in
+                    sched3.health()["fleet"]["lanes"].values()),
+        timeout=10.0)
+    fleet.rejoin("h0", t0.reopen())
+    sched3.close()
 
 
 def _drive_registry_events(mpath):
@@ -158,6 +221,7 @@ def test_every_record_kind_validates_and_is_covered(tmp_path):
     mpath = str(tmp_path / "metrics.jsonl")
     spath = str(tmp_path / "spans.jsonl")
     _drive_scheduler_events(mpath, spath)
+    _drive_fleet_and_host_events(mpath)
     _drive_registry_events(mpath)
     _drive_guardian_events(mpath)
 

@@ -628,6 +628,88 @@ def _build_engine_fleet():
     return build
 
 
+def _build_engine_hosts():
+    def build():
+        ensure_cpu()
+        import shutil
+        import tempfile
+
+        import numpy as np
+
+        from raft_tpu.serving.aot import AOTCache
+        from raft_tpu.serving.engine import RAFTEngine
+        from raft_tpu.serving.hosts import HostFleet, HostWorker
+        from raft_tpu.serving.transport import LoopbackTransport
+
+        variables, cfg = _engine_weights()
+        h, w = _IMAGE_HW
+        store = tempfile.mkdtemp(prefix="graftaudit_hosts_aot_")
+        remote = tempfile.mkdtemp(prefix="graftaudit_hosts_remote_")
+        try:
+            # the multi-host join recipe: the PRIMARY compiles its one
+            # bucket fresh and serializes it; the joining host's
+            # engine is built at PREWARM time against its OWN (empty)
+            # artifact root — it can only warm from what the fleet's
+            # admit PUSHED over the transport, sha256-verified. Zero
+            # XLA compiles on the joining host is the headline
+            # contract, pinned on the prewarm reply's own counters.
+            primary = RAFTEngine(variables, cfg, iters=_ITERS,
+                                 envelope=[(1, h, w)], precompile=True,
+                                 aot_cache=store)
+
+            def factory():
+                return RAFTEngine(variables, cfg, iters=_ITERS,
+                                  envelope=[(1, h, w)],
+                                  precompile=True, aot_cache=remote)
+
+            worker = HostWorker(engine_factory=factory,
+                                aot_root=remote)
+            fleet = HostFleet(
+                {"h0": LoopbackTransport(worker, name="h0")},
+                aot_cache=AOTCache(store), heartbeat_s=30.0,
+                reconnect_backoff_s=600.0)
+            try:
+                stats = fleet.admit_all()["h0"]
+                assert stats["compiles"] == 0, \
+                    f"joining host compiled instead of loading: {stats}"
+                assert stats["aot_hits"] >= 1, \
+                    f"joining host never hit pushed artifacts: {stats}"
+                assert stats["executables"] == 1, \
+                    f"host executable count drifted: {stats}"
+                host = fleet.health()["hosts"]["h0"]
+                assert host["push_entries"] >= 1 \
+                    and host["push_bytes"] > 0, \
+                    f"artifact push never shipped: {host}"
+                rng = np.random.RandomState(0)
+                i1 = rng.rand(1, h, w, 3).astype(np.float32) * 255
+                i2 = rng.rand(1, h, w, 3).astype(np.float32) * 255
+                want = np.asarray(primary.infer_batch(i1, i2))
+                got = np.asarray(
+                    fleet.hosts["h0"].engine.infer_batch(i1, i2))
+                assert np.array_equal(want, got), (
+                    "remote infer diverged from the primary (same "
+                    "weights, same pushed executable)")
+            finally:
+                fleet.close()
+            texts = tuple(exe.as_text()
+                          for exe in primary._compiled.values() if exe)
+            return CanaryResult(
+                observed_compiles=(len(primary._compiled)
+                                   + stats["executables"]),
+                detail=f"multi-host join at {h}x{w}: one bucket on "
+                       "the primary (the only fresh XLA compile) + "
+                       "one on the joining host, prewarmed entirely "
+                       "from artifacts pushed sha256-verified over "
+                       "the loopback transport (compiles=0, "
+                       "aot_hits>=1 on the prewarm reply); remote "
+                       "infer bitwise vs the primary",
+                hlo_texts=texts)
+        finally:
+            shutil.rmtree(store, ignore_errors=True)
+            shutil.rmtree(remote, ignore_errors=True)
+    return build
+
+
 def _build_registry_two_models():
     def build():
         ensure_cpu()
@@ -818,6 +900,22 @@ def build_targets() -> List[Target]:
                   "scheduler, one executable per replica with zero "
                   "XLA compiles past the primary (AOT-loaded) and no "
                   "cross-replica table leakage"),
+        Target(
+            name="engine_hosts",
+            kind="canary",
+            build=_build_engine_hosts(),
+            expect_compiles=2,     # one bucket on the primary + one on
+            #                        the joining host — but only the
+            #                        primary's is a fresh XLA compile;
+            #                        the host prewarms from artifacts
+            #                        PUSHED over the transport
+            #                        (compiles=0, aot_hits>=1, asserted
+            #                        in the build on the prewarm reply)
+            notes="multi-host join: sha256-verified artifact push "
+                  "over the loopback transport, prewarm "
+                  "loads-not-compiles (zero XLA compiles on the "
+                  "joining host), remote infer bitwise vs the "
+                  "primary"),
         Target(
             name="registry_two_models",
             kind="canary",

@@ -198,6 +198,27 @@ step serve_fleet_r6 2400 python -m raft_tpu.cli.serve_bench \
     --replicas 4 --aot-cache /tmp/raft_aot_fleet_r6 \
     --log-dir /tmp/raft_serve_fleet_r6
 
+# ---- multi-host fleet: kill-one failover drill (PR 18) ---------------
+# serve_bench_r6's traffic across 2 loopback host lanes behind the
+# transport seam: both hosts admitted via the sha256-verified artifact
+# push + prewarm BEFORE traffic (the hosts block's push_entries /
+# push_bytes prove the ship; prewarm rides the same AOT store — zero
+# extra XLA compiles per host), then h0's transport is poisoned while
+# the queue drains (--hosts-kill-one). The JSON line must show every
+# request settled (stranded 0, accounting_ok true, abandoned_inflight
+# 0) with the hosts block recording h0's missed-beat walk and the
+# failover; metrics.jsonl in the log dir carries the host_suspect /
+# host_dead / failover event evidence. The big shapes matter: seconds
+# of drain per dispatch is the in-flight window the verdict lands in.
+rm -rf /tmp/raft_aot_hosts_r6
+step serve_hosts_r6 2400 python -m raft_tpu.cli.serve_bench \
+    --shapes 440x1024,368x496 --requests 48 --submitters 2 \
+    --bucket-batch 4 --deadline-ms 60000 --gather-ms 20 \
+    --dispatch-timeout-ms 60000 --breaker-failures 2 \
+    --hosts 2 --hosts-kill-one \
+    --aot-cache /tmp/raft_aot_hosts_r6 \
+    --log-dir /tmp/raft_serve_hosts_r6
+
 # ---- request tracing: REAL tail exemplars + phase attribution (PR 14)
 # serve_bench_r6's traffic with the span ledger armed (full sampling —
 # this window wants every span): spans.jsonl lands beside the metrics,
