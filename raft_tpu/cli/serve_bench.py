@@ -114,12 +114,14 @@ CHAOS_SITES_REGISTRY = CHAOS_SITES + ("registry.load",)
 #: raises or hangs mid-decision must strand nothing and leave routing
 #: exactly as it found it (the site fires before any registry mutation)
 CHAOS_SITES_GUARDIAN = CHAOS_SITES_REGISTRY + ("guardian.decide",)
-#: multi-host drills add the remote lanes' three surfaces: both wire
+#: multi-host drills add the remote lanes' four surfaces: both wire
 #: directions (a corrupted/raised exchange must fail over or settle
-#: cleanly, never strand) and the heartbeat probe (missed beats walk
-#: the suspect->dead ladder and the verdict consequences fire)
+#: cleanly, never strand), the heartbeat probe (missed beats walk the
+#: suspect->dead ladder and the verdict consequences fire), and the
+#: worker's infer execution itself (a host dying MID-BATCH — the
+#: failover-requeue path, not just the probe path)
 CHAOS_SITES_HOSTS = CHAOS_SITES + ("transport.send", "transport.recv",
-                                   "host.heartbeat")
+                                   "host.heartbeat", "host.infer")
 
 
 def chaos_plan(rng: random.Random, hang_s: float = 0.5,

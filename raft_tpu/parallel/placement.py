@@ -44,6 +44,20 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+#: graftthread declarations, now that raft_tpu/parallel/ sits inside
+#: the argument-less gate scope: Placement is deliberately LOCK-FREE —
+#: the scheduler calls every method here while holding its own locks
+#: (lane selection under ``_cv``, host marks from the verdict path),
+#: so this layer must never acquire anything of its own (a lock here
+#: would nest under every scheduler lock and belong in its
+#: LOCK_ORDER). The empty chain is the declared contract, not an
+#: omission; graftthread verifies no ``with <lock>`` ever appears.
+LOCK_ORDER = ()
+
+GRAFTTHREAD = {
+    "locks": (),
+}
+
 #: padded H*W at/above which a bucket is 4K-class: one pair's FLOPs are
 #: worth pjit-sharding across the mesh instead of replicating the whole
 #: micro-batch (2160x3840 = UHD)

@@ -71,6 +71,23 @@ GRAFTTHREAD = {
     "locks": ("_lock",),
 }
 
+#: graftwire declarations. Every worker method is idempotent BY
+#: CONTRACT (the TransportError-always-retryable design): ping/stats/
+#: capacity are reads; put_artifact re-verifies and no-ops on a digest
+#: already installed; prewarm re-warms to the same engine; ensure/
+#: route/drop converge on the same bucket table; infer is pure;
+#: update_weights sets the tree to the SAME value on re-send. A new
+#: method that is NOT safe to re-send must ship a request_id in its
+#: payload instead of a row here — W2 holds every call site to one or
+#: the other. ``_emit`` wraps metrics.record_event, so its literals
+#: are schema-checked like direct calls (W6).
+GRAFTWIRE = {
+    "idempotent": ("ping", "put_artifact", "prewarm", "capacity",
+                   "ensure", "route", "drop", "infer",
+                   "update_weights", "stats"),
+    "event_emitters": ("_emit",),
+}
+
 HOST_HEALTHY = "healthy"
 HOST_SUSPECT = "suspect"
 HOST_DEAD = "dead"

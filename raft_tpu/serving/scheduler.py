@@ -190,6 +190,19 @@ GRAFTTHREAD = {
     "settles": ("_fail_requests",),
 }
 
+#: graftwire W4: the dead-HOST verdict must land every cross-seam
+#: consequence (breaker, executor quarantine, placement mark,
+#: transport poison — the one that unsticks a thread blocked in the
+#: zombie's recv) before the in-flight batch is failed over or failed;
+#: ``_failover_requeue`` counts as a settle because requeued requests
+#: become visible to surviving lanes the moment they hit the queue.
+GRAFTWIRE = {
+    "verdicts": ("_wedge_host",),
+    "consequences": ("record_failure", "quarantine_and_replace",
+                     "mark_host", "poison"),
+    "settles": ("_fail_requests", "_failover_requeue"),
+}
+
 
 class BackpressureError(RuntimeError):
     """Queue at max_queue: shed — the submitter should back off/retry.

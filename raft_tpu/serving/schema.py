@@ -65,6 +65,11 @@ EVENT_FIELDS: Dict[str, frozenset] = {
     "model_promote": frozenset({"model", "version", "mode"}),
     "model_rollback": frozenset({"model", "version"}),
     "registry_closed": frozenset({"models"}),
+    # AOT-store GC on variant retirement (registry._retire_artifacts;
+    # undeclared until the graftwire W6 first scan caught the drift —
+    # the dynamic drill had never driven the eviction path)
+    "aot_evicted": frozenset({"model", "version", "removed",
+                              "removed_bytes"}),
     # replica fleet (scheduler fleet mode — replicas>1 or host lanes)
     "replica_quarantined": frozenset({"replica", "bucket"}),
     "replica_activated": frozenset({"replica", "queue_depth"}),
@@ -87,6 +92,27 @@ EVENT_FIELDS: Dict[str, frozenset] = {
     "guardian_decision_failed": frozenset({"model", "version",
                                            "intended", "error"}),
     "guardian_error": frozenset({"error"}),
+}
+
+#: the wire-protocol method registry: every method a transport client
+#: may ``call()`` and a :class:`~raft_tpu.serving.hosts.HostWorker`
+#: must table (``_m_<method>``), mapped to the payload keys the worker
+#: REQUIRES (the additive contract again: extra payload keys are never
+#: an error; a method lands HERE first). The graftwire W6 tier checks
+#: every client call string and handler entry against these keys
+#: statically; tests/test_serving_schema.py pins the table against the
+#: real HostWorker surface.
+WIRE_METHODS: Dict[str, frozenset] = {
+    "ping": frozenset(),
+    "put_artifact": frozenset({"digest", "blob", "manifest", "sha256"}),
+    "prewarm": frozenset(),
+    "capacity": frozenset({"h", "w"}),
+    "ensure": frozenset({"n", "h", "w"}),
+    "route": frozenset({"n", "h", "w"}),
+    "drop": frozenset({"bucket"}),
+    "infer": frozenset({"image1", "image2"}),
+    "update_weights": frozenset({"variables"}),
+    "stats": frozenset(),
 }
 
 #: span record types (serving/trace.py) → required fields. Request

@@ -56,6 +56,16 @@ GRAFTTHREAD = {
     "locks": ("_lock",),
 }
 
+#: graftwire declarations: holding ``_lock`` across the socket I/O IS
+#: the transport contract (one request in flight per connection), so
+#: it is a wire lock, not a W3 finding; ``_send_msg``/``_recv_exact``
+#: are the ONLY functions allowed to touch raw socket send/recv — all
+#: framing lives there (W6).
+GRAFTWIRE = {
+    "wire_locks": ("_lock",),
+    "framed_helpers": ("_send_msg", "_recv_exact"),
+}
+
 _LEN = struct.Struct(">Q")
 #: sanity bound on a single message (a corrupted length prefix must
 #: read as a protocol error, not a 2**60-byte allocation)

@@ -109,6 +109,7 @@ plan machinery never touches the hot path unless armed.
 
 from __future__ import annotations
 
+import difflib
 import json
 import os
 import random
@@ -120,6 +121,46 @@ from typing import List, Optional
 #: distinct from WEDGED_EXIT_CODE so runbooks and the supervisor can
 #: tell a drill's injected crash from a real wedge
 CRASH_EXIT_CODE = 41
+
+#: the canonical fault-site registry: every dotted ``area.point`` site
+#: armed anywhere in raft_tpu/, one line each (the module docstring
+#: carries the long-form contracts). Plans validate against this table
+#: at parse time — a typo'd site used to arm nothing and silently
+#: shrink the drill — and the graftwire W7 tier cross-references it
+#: against the chaos plans so every registered site is provably armed
+#: AND drawn. Add the row in the same commit that adds the
+#: ``fault_point``/``fault_file``/``fault_data`` call.
+KNOWN_SITES = {
+    "loader.sample": "per-sample decode in PrefetchLoader workers",
+    "trainer.step": "top of the training loop, once per step",
+    "ckpt.msgpack_write": "weights-only msgpack save (tmp/rename "
+                          "window; corrupt = post-save bit rot)",
+    "ckpt.orbax_save": "full-state Orbax save (corrupt smashes a "
+                       "just-written step file)",
+    "serve.request": "per micro-batch dispatch in the serving "
+                     "scheduler's worker",
+    "serve.dispatch_exec": "top of the supervised dispatch executor's "
+                           "job loop (watchdog quarantine drill)",
+    "serve.fetch": "PendingBatch.fetch blocking D2H read (completion-"
+                   "stage hang)",
+    "engine.compile": "immediately before a real XLA bucket compile",
+    "registry.load": "start of a model-variant build (deploy auto-"
+                     "rollback drill)",
+    "guardian.decide": "SLO guardian verdict execution point (after "
+                       "judgment, before registry action)",
+    "aot.load": "serialized-executable cache verified load (corrupt = "
+                "artifact bit rot; contract: clean miss)",
+    "scheduler.swap": "per-replica weight application inside the "
+                      "quiesced swap epoch (all-or-nothing)",
+    "transport.send": "Transport.call request side (corrupt zero-"
+                      "fills the encoded request in transit)",
+    "transport.recv": "Transport.call reply side (same retry "
+                      "contract)",
+    "host.heartbeat": "one heartbeat probe in HostFleet.beat (missed-"
+                      "beat ladder drill)",
+    "host.infer": "remote host worker's infer execution "
+                  "(serving/hosts.py — mid-batch host death drill)",
+}
 
 _POINT_KINDS = ("raise", "hang", "crash")
 _ALL_KINDS = _POINT_KINDS + ("corrupt",)
@@ -135,6 +176,19 @@ class _Entry:
 
     def __init__(self, spec: dict):
         self.site = spec["site"]
+        # dotted names are the real `area.point` namespace and must be
+        # registered; undotted names stay legal — the fault machinery's
+        # own unit tests arm synthetic sites ("x", "y") that exist only
+        # in the test body
+        if "." in self.site and self.site not in KNOWN_SITES:
+            near = difflib.get_close_matches(self.site, KNOWN_SITES,
+                                             n=1)
+            hint = f" — did you mean {near[0]!r}?" if near else ""
+            raise ValueError(
+                f"unknown fault site {self.site!r}: not in "
+                f"faults.KNOWN_SITES{hint} (a typo'd site arms "
+                "nothing and the drill silently tests less than it "
+                "claims)")
         self.at = int(spec.get("at", 1))
         self.kind = spec["kind"]
         self.hang_s = float(spec.get("hang_s", 3600.0))

@@ -1,0 +1,16 @@
+"""Cross-file drift, client half: calls "ping" (handled by
+w1_server.py) and "route" (NOT handled there — the drift only a union
+pass over both files can see). Idempotency declarations live on the
+server module, so this file linted ALONE also fires W2 — and goes
+silent in the union."""
+
+
+class FleetClient:
+    def __init__(self, transport):
+        self._t = transport
+
+    def beat(self):
+        return self._t.call("ping")
+
+    def route(self, n, h, w):
+        return self._t.call("route", {"n": n, "h": h, "w": w})

@@ -1,0 +1,27 @@
+"""W1 negative: every call has a handler, every handler a caller."""
+
+GRAFTWIRE = {
+    "idempotent": ("ping", "stats"),
+}
+
+
+class Worker:
+    def handle(self, method, payload):
+        return getattr(self, "_m_" + method)(payload)
+
+    def _m_ping(self, payload):
+        return True
+
+    def _m_stats(self, payload):
+        return {}
+
+
+class Client:
+    def __init__(self, transport):
+        self._t = transport
+
+    def ping(self):
+        return self._t.call("ping")
+
+    def stats(self):
+        return self._t.call("stats")

@@ -1,0 +1,24 @@
+"""W1 positive: method-table drift in both directions — a client call
+with no handler, and a handler with no caller."""
+
+
+class Worker:
+    def handle(self, method, payload):
+        return getattr(self, "_m_" + method)(payload)
+
+    def _m_ping(self, payload):
+        return True
+
+    def _m_orphan(self, payload):     # registered, never called
+        return None
+
+
+class Client:
+    def __init__(self, transport):
+        self._t = transport
+
+    def ping(self):
+        return self._t.call("ping")
+
+    def frobnicate(self):
+        return self._t.call("frobnicate")   # no _m_frobnicate anywhere

@@ -1,0 +1,18 @@
+"""Cross-file drift, server half: handles "ping" (called by
+w1_client.py) and "drop" (called by NOBODY — dead protocol surface
+only the union pass can see)."""
+
+GRAFTWIRE = {
+    "idempotent": ("ping", "route", "drop"),
+}
+
+
+class FleetWorker:
+    def handle(self, method, payload):
+        return getattr(self, "_m_" + method)(payload)
+
+    def _m_ping(self, payload):
+        return True
+
+    def _m_drop(self, payload):
+        return None

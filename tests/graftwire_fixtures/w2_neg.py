@@ -1,0 +1,21 @@
+"""W2 negative: the three legal shapes — declared idempotent, a
+request_id keyword, and a request_id key in the payload dict."""
+
+GRAFTWIRE = {
+    "idempotent": ("ping",),
+}
+
+
+class SafeClient:
+    def __init__(self, transport):
+        self._t = transport
+
+    def beat(self):
+        return self._t.call("ping")
+
+    def infer(self, a, b):
+        return self._t.call("infer", {"request_id": "r-1",
+                                      "image1": a, "image2": b})
+
+    def stats(self):
+        return self._t.call("stats", request_id="r-2")

@@ -1,0 +1,10 @@
+"""W2 positive: a mutating remote call, neither declared idempotent
+nor carrying a request_id — a retry double-applies it."""
+
+
+class CounterClient:
+    def __init__(self, transport):
+        self._t = transport
+
+    def bump(self, n):
+        return self._t.call("increment", {"by": n})

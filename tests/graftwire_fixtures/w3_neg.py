@@ -1,0 +1,38 @@
+"""W3 negative: a declared wire lock IS the per-connection
+serialization contract (holding it across the I/O is the design), and
+ordinary locks release before the RPC leaves."""
+
+import threading
+
+GRAFTWIRE = {
+    "idempotent": ("ping",),
+    "wire_locks": ("_lock",),
+    "framed_helpers": ("_send_msg",),
+}
+
+
+def _send_msg(sock, data):
+    sock.sendall(data)
+
+
+class Transport:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+
+    def send(self, data):
+        with self._lock:                 # serialization IS the contract
+            _send_msg(self._sock, data)
+
+
+class Fleet:
+    def __init__(self, transport):
+        self._state_lock = threading.Lock()
+        self._transport = transport
+        self._alive = True
+
+    def beat(self):
+        with self._state_lock:
+            alive = self._alive
+        if alive:
+            self._transport.call("ping")   # lock released first

@@ -1,0 +1,32 @@
+"""W3 positive: wire round-trips lexically inside held scheduler-ish
+locks — every contending thread wedges for the full RTT."""
+
+import threading
+
+GRAFTWIRE = {
+    "idempotent": ("ping", "stats"),
+}
+
+
+class Fleet:
+    def __init__(self, transport):
+        self._lock = threading.Lock()
+        self._transport = transport
+
+    def beat(self):
+        with self._lock:
+            return self._transport.call("ping")     # RPC under lock
+
+
+class Pusher:
+    def __init__(self, sock):
+        self._reg_lock = threading.Lock()
+        self._sock = sock
+
+    def push(self, data):
+        with self._reg_lock:
+            self._sock.sendall(data)                # socket I/O under lock
+
+    def reap(self, proc):
+        with self._reg_lock:
+            return proc.wait()                      # subprocess wait under lock

@@ -1,0 +1,24 @@
+"""W4 negative: consequences strictly before the first settle — the
+PR-18 `_wedge_host` ordering."""
+
+GRAFTWIRE = {
+    "verdicts": ("wedge_host",),
+    "consequences": ("quarantine", "poison"),
+    "settles": ("fail_requests",),
+}
+
+
+class Sched:
+    def wedge_host(self, name, requests):
+        self.quarantine(name)
+        self.poison(name)
+        self.fail_requests(requests)      # settle LAST: the contract
+
+    def quarantine(self, name):
+        pass
+
+    def poison(self, name):
+        pass
+
+    def fail_requests(self, requests):
+        pass

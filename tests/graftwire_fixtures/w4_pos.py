@@ -1,0 +1,22 @@
+"""W4 positive: a host-verdict function settles caller-visible futures
+BEFORE any declared consequence — a woken caller can re-submit into
+the dead lane."""
+
+GRAFTWIRE = {
+    "verdicts": ("wedge_host",),
+    "consequences": ("quarantine", "poison"),
+}
+
+
+class Sched:
+    def wedge_host(self, name, requests):
+        for r in requests:
+            r.future.set_result(None)     # settle FIRST: the bug
+        self.quarantine(name)
+        self.poison(name)
+
+    def quarantine(self, name):
+        pass
+
+    def poison(self, name):
+        pass

@@ -1,0 +1,32 @@
+"""W5 negative: the same loops, paced through backoff_delays — bounded,
+factor-grown, jittered."""
+
+import time
+
+from raft_tpu.utils.retry import backoff_delays
+
+GRAFTWIRE = {
+    "idempotent": ("ping",),
+}
+
+
+def reconnect(transport):
+    delays = backoff_delays(base_s=0.1, factor=2.0, max_s=5.0)
+    while True:
+        try:
+            transport.reopen()
+            return
+        except ConnectionError:
+            time.sleep(next(delays))      # blessed: visibly backoff-fed
+
+
+def poll_until_up(transport):
+    delays = backoff_delays(base_s=0.1, factor=2.0, max_s=5.0)
+    for _ in range(100):
+        try:
+            transport.call("ping")
+            return True
+        except OSError:
+            delay = next(delays)
+            time.sleep(delay)             # blessed via the named delay
+    return False

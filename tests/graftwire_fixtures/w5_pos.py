@@ -1,0 +1,23 @@
+"""W5 positive: reconnect loops paced by hand-rolled constant sleeps —
+the unbounded hammer."""
+
+import time
+
+
+def reconnect(transport):
+    while True:
+        try:
+            transport.reopen()
+            return
+        except ConnectionError:
+            time.sleep(0.5)               # constant-rate hammer
+
+
+def poll_until_up(transport):
+    for _ in range(100):
+        try:
+            transport.call("ping")
+            return True
+        except OSError:
+            time.sleep(1.0)               # same hammer, for-loop form
+    return False

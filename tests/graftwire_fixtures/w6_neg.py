@@ -1,0 +1,50 @@
+"""W6 negative: declared events (exact and constant-prefix), registered
+wire methods, and raw socket verbs only inside blessed framed
+helpers."""
+
+import struct
+
+GRAFTWIRE = {
+    "idempotent": ("ping", "stats"),
+    "framed_helpers": ("_send_msg", "_recv_exact"),
+    "event_emitters": ("_emit",),
+}
+
+_LEN = struct.Struct(">Q")
+
+
+def _send_msg(sock, data):
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class Lane:
+    def __init__(self, metrics):
+        self._metrics = metrics
+
+    def _emit(self, kind, **fields):
+        self._metrics.record_event(kind, **fields)
+
+    def beat(self, transport, state):
+        transport.call("ping")
+        transport.call("stats")
+        self._emit("host_suspect", host="h0", missed=1)
+        self._emit("breaker_" + state, bucket="b", previous="open")
+
+
+class Worker:
+    def handle(self, method, payload):
+        return getattr(self, "_m_" + method)(payload)
+
+    def _m_ping(self, payload):
+        return True
+
+    def _m_stats(self, payload):
+        return {}

@@ -1,0 +1,25 @@
+"""W6 positive: schema drift everywhere — an undeclared event kind, an
+undeclared constant prefix, an unregistered wire method (both call and
+handler sides), and raw socket verbs outside any framed helper."""
+
+
+def emit_things(metrics, state):
+    metrics.record_event("totally_undeclared_event", x=1)
+    metrics.record_event("zzz_" + state, bucket="b")
+
+
+def call_things(transport):
+    return transport.call("not_in_the_registry")
+
+
+def leak_bytes(sock, payload):
+    sock.send(payload)                    # unframed: drift becomes a hang
+    return sock.recv(4096)
+
+
+class Worker:
+    def handle(self, method, payload):
+        return getattr(self, "_m_" + method)(payload)
+
+    def _m_not_in_the_registry(self, payload):
+        return None

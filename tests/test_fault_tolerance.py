@@ -74,6 +74,32 @@ class TestFaultPlan:
         with pytest.raises(ValueError, match="1-based"):
             faults.arm([{"site": "x", "kind": "raise", "at": 0}])
 
+    def test_unknown_dotted_site_rejected_with_nearest_hint(self):
+        # a typo'd production site arms NOTHING — the drill then
+        # silently tests less than it claims, so arm() fails loudly at
+        # parse time and names the nearest real site
+        with pytest.raises(ValueError, match=r"transport\.snd.*did "
+                                             r"you mean 'transport\.send'"):
+            faults.arm([{"site": "transport.snd", "kind": "raise"}])
+        with pytest.raises(ValueError, match="KNOWN_SITES"):
+            faults.arm([{"site": "serve.bogus_phase", "kind": "raise"}])
+        # every production site is dotted; undotted synthetic names
+        # (this file's "x"/"y"/"f" machinery drills) stay legal
+        faults.arm([{"site": "x", "kind": "raise"}])
+        assert faults.armed("x")
+        faults.disarm()
+
+    def test_known_sites_table_matches_armed_reality(self):
+        # every declared site validates; the table carries a one-line
+        # description (it doubles as the chaos-surface inventory the
+        # graftwire W7 audit reads)
+        for site, desc in faults.KNOWN_SITES.items():
+            assert "." in site, site
+            assert isinstance(desc, str) and desc, site
+        faults.arm([{"site": s, "kind": "raise", "at": 10 ** 9}
+                    for s in faults.KNOWN_SITES])
+        faults.disarm()
+
     def test_fault_file_zeroes_content(self, tmp_path):
         p = tmp_path / "blob"
         p.write_bytes(b"A" * 300)

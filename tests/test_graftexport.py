@@ -260,14 +260,15 @@ class TestRepoGate:
                                 "serve_cached", "serve_ragged"}
         assert all(t.kind == "engine" for t in targets.values())
 
-    def test_meta_gate_runs_five_tiers(self):
-        """``python -m tools.graft`` fans out over FIVE tiers now —
-        the fifth is this one. Pinned against the tier table (the full
-        five-tier run is the pre-commit command; the expensive tiers
-        have their own gate tests)."""
+    def test_meta_gate_runs_six_tiers(self):
+        """``python -m tools.graft`` fans out over SIX tiers now —
+        graftexport plus the wire tier behind it. Pinned against the
+        tier table (the full six-tier run is the pre-commit command;
+        the expensive tiers have their own gate tests)."""
         from tools.graft import TIER_ARGS, TIERS
         assert "graftexport" in TIER_ARGS
-        assert len(TIERS) == 5
+        assert "graftwire" in TIER_ARGS
+        assert len(TIERS) == 6
         # usage errors stay usage errors
         r = subprocess.run(
             [sys.executable, "-m", "tools.graft", "--tiers", "nope"],

@@ -430,6 +430,33 @@ class TestFleetFailoverLoopback:
         finally:
             sched.close()
 
+    def test_infer_fault_fails_over_and_recovers(self, tmp_path):
+        """The host.infer chaos site drawn end-to-end: a worker-side
+        infer raise crosses the wire as TransportError mid-dispatch,
+        the live batch fails over by requeue to the surviving lanes
+        (scheduler's before-any-heartbeat-verdict path), and once the
+        one-shot fault exhausts every future still settles bitwise
+        with the accounting identity intact."""
+        mpath = str(tmp_path / "metrics.jsonl")
+        sched, fleet, _t0 = self._stack(mpath)
+        try:
+            faults.arm([{"site": "host.infer", "kind": "raise",
+                         "count": 1}])
+            pairs = _pairs(30)
+            futs = [sched.submit(a, b) for a, b in pairs]
+            for (a, b), f in zip(pairs, futs):
+                flow = np.asarray(f.result(timeout=60).flow)
+                assert np.array_equal(flow, _stub_oracle(a, b))
+            assert not faults.armed("host.infer")   # the drill DREW it
+            snap = sched.metrics.snapshot()
+            assert snap["submitted"] == 30 == snap["completed"]
+            assert snap["failed"] == 0
+            assert snap["abandoned_inflight"] == 0   # zero stranded
+            assert _accounting_ok(snap)
+            assert "failover" in _events(mpath)
+        finally:
+            sched.close()
+
     def test_hosts_zero_is_bitwise_pr17(self, tmp_path):
         """The migration pin: no fleet -> no hosts surface at all."""
         sched = MicroBatchScheduler(StubEngine(), gather_window_s=0.0)

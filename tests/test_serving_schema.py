@@ -140,12 +140,23 @@ def _drive_fleet_and_host_events(mpath):
 
 def _drive_registry_events(mpath):
     """model_state / model_deploy / model_promote / model_rollback /
-    model_deploy_failed / registry_closed, through real rollouts."""
+    model_deploy_failed / aot_evicted / registry_closed, through real
+    rollouts. The rolled-back canary carries a (fake) AOT store +
+    weights fingerprint so its retirement drives the GC path — the
+    aot_evicted emitter the graftwire first scan found undeclared."""
+
+    class _FakeAot:
+        def evict(self, max_bytes=None, weights=None):
+            return {"removed": 1, "removed_bytes": 128}
+
     reg = ModelRegistry(metrics_path=mpath, gather_window_s=0.0)
     reg.add_model("m", {}, RAFTConfig(), engine=_WarmFakeEngine())
     reg.deploy("m", {}, engine=_WarmFakeEngine(), canary_fraction=0.5)
     reg.promote("m")
-    reg.deploy("m", {}, engine=_WarmFakeEngine(), canary_fraction=0.5)
+    canary_eng = _WarmFakeEngine()
+    canary_eng._aot = _FakeAot()
+    canary_eng._weights_fp = "fp-canary"
+    reg.deploy("m", {}, engine=canary_eng, canary_fraction=0.5)
     reg.rollback("m")
     faults.arm([{"site": "registry.load", "kind": "raise", "count": 1}])
     with pytest.raises(DeployError):
@@ -240,6 +251,69 @@ def test_every_record_kind_validates_and_is_covered(tmp_path):
     assert kinds == set(schema.RECORD_KINDS)
     spans = {r["span"] for r in recs if r.get("kind") == "span"}
     assert spans == set(schema.SPAN_KINDS)
+
+
+def test_static_every_record_event_literal_is_declared():
+    """The static twin of the dynamic drill above (and of graftwire's
+    W6 tier): walk every ``record_event(...)`` / ``_emit(...)`` call
+    under raft_tpu/serving/ whose kind is a string literal (or a
+    constant-prefix BinOp like ``"breaker_" + state``) and assert the
+    kind resolves in EVENT_FIELDS — an emitter added without a schema
+    entry fails HERE at parse time, not at the first drill that
+    happens to drive it."""
+    import ast
+    import os
+
+    serving_dir = os.path.dirname(os.path.abspath(schema.__file__))
+    events = set(schema.EVENT_FIELDS)
+    problems, literals = [], 0
+    for name in sorted(os.listdir(serving_dir)):
+        if not name.endswith(".py"):
+            continue
+        path = os.path.join(serving_dir, name)
+        tree = ast.parse(open(path, encoding="utf-8").read(),
+                         filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            attr = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if attr not in ("record_event", "_emit"):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str):
+                literals += 1
+                if arg.value not in events:
+                    problems.append(
+                        f"{name}:{node.lineno}: {arg.value!r}")
+            elif isinstance(arg, ast.BinOp) and \
+                    isinstance(arg.op, ast.Add) and \
+                    isinstance(arg.left, ast.Constant) and \
+                    isinstance(arg.left.value, str):
+                literals += 1
+                if not any(e.startswith(arg.left.value)
+                           for e in events):
+                    problems.append(f"{name}:{node.lineno}: prefix "
+                                    f"{arg.left.value!r}")
+    assert problems == [], \
+        "record_event kinds with no EVENT_FIELDS entry: " \
+        + "; ".join(problems)
+    # the walk actually saw the emitters (a refactor that moves them
+    # out of serving/ must update this drill, not silently skip it)
+    assert literals >= 20
+
+
+def test_wire_methods_registry_matches_worker_table():
+    """WIRE_METHODS <-> the real HostWorker ``_m_*`` surface, pinned
+    both ways: a handler added without a registry entry (or a registry
+    row whose handler was dropped) fails here."""
+    from raft_tpu.serving.hosts import HostWorker
+
+    table = {m[len("_m_"):] for m in dir(HostWorker)
+             if m.startswith("_m_")}
+    assert table == set(schema.WIRE_METHODS)
 
 
 def test_validator_rejects_drift():

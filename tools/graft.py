@@ -1,10 +1,11 @@
 """graft: the one-command static-analysis meta-gate.
 
-Runs all five tiers — graftlint (source), graftaudit (single-device
+Runs all six tiers — graftlint (source), graftaudit (single-device
 compiled artifacts), graftthread (thread-safety declarations),
 graftshard (partitioned programs on the forced multi-device CPU mesh),
 graftexport (serialized executables round-tripped through the AOT
-artifact cache) — and merges their machine-readable output into one
+artifact cache), graftwire (wire-protocol invariants across the
+multi-host seam) — and merges their machine-readable output into one
 JSON summary with one exit code. This is the pre-commit check::
 
     python -m tools.graft --json
@@ -12,13 +13,15 @@ JSON summary with one exit code. This is the pre-commit check::
 Exit codes: 0 every tier clean, 1 any tier found something (its
 findings are in the summary), 2 usage error or a tier that failed to
 run at all. Each tier runs in its own subprocess: the tiers disagree
-about interpreter state on purpose (graftlint/graftthread must never
-import jax; graftshard must configure the virtual mesh BEFORE jax
-initializes; graftexport pins the single-device CPU backend), and
-isolation keeps each tier's contract intact.
+about interpreter state on purpose (graftlint/graftthread/graftwire
+must never import jax; graftshard must configure the virtual mesh
+BEFORE jax initializes; graftexport pins the single-device CPU
+backend), and isolation keeps each tier's contract intact.
 
 ``--tiers a,b`` runs a subset (the test gate uses the stdlib tiers to
-stay fast; CI and pre-commit run all five).
+stay fast; CI and pre-commit run all six). Each tier's summary block
+carries its wall time (``seconds``) and finding count (``count``) so a
+slow or noisy tier is visible from the merged output alone.
 """
 
 from __future__ import annotations
@@ -48,6 +51,7 @@ TIER_ARGS = {
     "graftthread": [],
     "graftshard": [],
     "graftexport": [],
+    "graftwire": [],
 }
 TIERS = tuple(TIER_ARGS)
 
@@ -68,6 +72,7 @@ def run_tier(name: str) -> dict:
     rec = {
         "exit": proc.returncode,
         "findings": findings,
+        "count": len(findings),
         "seconds": round(dt, 1),
     }
     if parse_error or proc.returncode not in (0, 1):
@@ -81,10 +86,10 @@ def run_tier(name: str) -> dict:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="graft",
-        description="Run all five static-analysis tiers (graftlint, "
-                    "graftaudit, graftthread, graftshard, graftexport) "
-                    "with one merged JSON summary and one exit code — "
-                    "the pre-commit gate.")
+        description="Run all six static-analysis tiers (graftlint, "
+                    "graftaudit, graftthread, graftshard, graftexport, "
+                    "graftwire) with one merged JSON summary and one "
+                    "exit code — the pre-commit gate.")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable merged summary")
     p.add_argument("--tiers", metavar="T1,T2",

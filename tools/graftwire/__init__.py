@@ -1,0 +1,37 @@
+"""graftwire — wire-protocol static analysis for the multi-host fleet.
+
+The sixth analysis tier: where graftthread's T-rules stop at the
+thread seam, the W-rules follow the serving stack across the process
+boundary (serving/transport.py, serving/hosts.py) and mechanize the
+bug classes PRs 6-18 caught by hand, re-appearing on the wire:
+
+- W1 method-table-drift        — client `call("m")` strings vs worker
+                                 `_m_m` handler tables, cross-file
+- W2 unretryable-call          — retried remote calls neither declared
+                                 idempotent nor carrying a request_id
+- W3 wire-call-under-lock      — transport/socket/subprocess waits
+                                 inside `with <lock>` (T1 over the
+                                 seam; GRAFTWIRE['wire_locks'] exempts
+                                 the transport's own serialization)
+- W4 settle-before-consequence — host-verdict fns settling futures
+                                 before quarantine/failover land (T6
+                                 across host verdicts)
+- W5 unbounded-retry-loop      — reconnect loops not paced by
+                                 utils/retry.backoff_delays
+- W6 wire-schema-drift         — events/methods absent from the
+                                 serving/schema.py registry; raw
+                                 socket I/O outside framed helpers
+- W7 undrilled-fault-site      — armed fault_point sites no chaos
+                                 plan ever draws (KNOWN_SITES is the
+                                 single source of truth)
+
+Run ``python -m tools.graftwire --help`` from the repo root; the
+tier-1 gate is ``tests/test_graftwire.py``.
+"""
+
+from .core import (DEFAULT_PATHS, apply_baseline, lint_file, lint_paths,
+                   load_baseline, main, write_baseline)
+from .finding import Finding
+
+__all__ = ["Finding", "DEFAULT_PATHS", "apply_baseline", "lint_file",
+           "lint_paths", "load_baseline", "main", "write_baseline"]

@@ -1,36 +1,36 @@
-"""graftthread driver: walk files, run rules, global lock graph, CLI.
+"""graftwire driver: walk files, run W-rules, global union pass, CLI.
 
 Usage (from the repo root; the argument-less form is the tier-1
 gate)::
 
-    python -m tools.graftthread --json
-    python -m tools.graftthread raft_tpu/serving some_file.py \
-        --baseline tools/graftthread/baseline.json
+    python -m tools.graftwire --json
+    python -m tools.graftwire raft_tpu/serving some_file.py \
+        --baseline tools/graftwire/baseline.json
 
-With no paths the scan covers :data:`DEFAULT_PATHS` — the
-multi-threaded serving stack, the training supervisor, and the shared
-utils — the tree whose concurrency invariants T1-T6 encode. Exit
-codes: 0 clean (modulo baseline), 1 new findings, 2 usage/parse error.
-``--json`` prints a machine-readable findings list; ``--write-baseline``
-regenerates the grandfather file (shrink-only discipline, as in
-graftlint/graftaudit — the shipped baseline is EMPTY and must stay
-that way: findings are fixed or pragma-waived with justification,
-never silently baselined).
+With no paths the scan covers :data:`DEFAULT_PATHS` — the wire-facing
+serving stack, the placement/parallel layer, and the fault-injection
+seam. Exit codes: 0 clean (modulo baseline), 1 new findings, 2
+usage/parse error. ``--json`` prints a machine-readable findings list;
+``--write-baseline`` regenerates the grandfather file (shrink-only
+discipline; the SHIPPED baseline is EMPTY and must stay that way —
+findings are fixed or pragma-waived with justification, never
+silently baselined).
 
-Suppression: ``# graftthread: disable=T1,T5   (justification)`` on the
-finding's anchor line. T3 cycle findings anchor at the cycle's
-lexicographically-first edge site (a ``LOCK_ORDER`` chain line or an
-inferred nested-``with`` line).
+Suppression: ``# graftwire: disable=W1,W6   (justification)`` on the
+finding's anchor line.
 
-Two passes per run: the per-file rules (T1/T2/T4/T5/T6, plus T3 over a
-*single* file's edges in ``lint_file``), then — in ``lint_paths`` —
-the GLOBAL T3 pass over the union of every file's declared + inferred
-acquisition edges, where cross-module cycles (scheduler→breaker→
-metrics, registry→scheduler) actually close. The content-hash parse
-cache (tools/lintcache, shared with graftlint) stores each file's
-findings, edges, and pragma lines; the global graph pass re-runs every
-time (it is a dict walk, not a parse) so a cache hit can never hide a
-cross-file cycle.
+Three passes per run: the per-file rules (W3-W6 in ``scan_file``),
+then — in ``lint_paths`` — the GLOBAL W1/W2 pass over the union of
+every file's wire facts (client call sites vs worker handler tables
+live in different modules, so drift only closes here, like
+graftthread's T3 union graph), and the repo-level W7 fault-coverage
+cross-reference whenever the scanned set includes
+``raft_tpu/testing/faults.py``. The content-hash parse cache
+(tools/lintcache, shared with the other tiers) stores each file's
+findings, facts, and pragma lines; the union and W7 passes re-run
+every time (dict walks, not parses) so a cache hit can never hide
+cross-file drift. The cache signature folds in the schema registry's
+digest — editing ``serving/schema.py`` invalidates cached W6 results.
 """
 
 from __future__ import annotations
@@ -48,18 +48,18 @@ try:
 except ImportError:          # invoked as a top-level package (tests
     import lintcache         # insert the repo root on sys.path)
 
-from .declarations import ThreadAnalysis
+from .declarations import WireAnalysis, WireFacts
 from .finding import Finding
+from . import schema_registry
 
-#: the argument-less scan: the multi-threaded serving stack, the
-#: process supervisor, the placement/parallel layer (the scheduler's
-#: fleet decisions call into it from lock-holding paths), and the
-#: shared utils (watchdog's poll thread, retry, timing) — relative to
-#: the repo root the gate runs from
+#: the argument-less scan: everything that touches the wire — the
+#: serving stack (transport/hosts/scheduler/registry), the placement
+#: and parallel layer, and the fault-injection seam W7 audits
 DEFAULT_PATHS = ("raft_tpu/serving",
-                 os.path.join("raft_tpu", "training", "supervisor.py"),
                  "raft_tpu/parallel",
-                 "raft_tpu/utils")
+                 os.path.join("raft_tpu", "testing", "faults.py"))
+
+FAULTS_SUFFIX = os.path.join("raft_tpu", "testing", "faults.py")
 
 
 def collect_files(paths: Sequence[str]) -> List[str]:
@@ -67,7 +67,7 @@ def collect_files(paths: Sequence[str]) -> List[str]:
 
 
 def parse_pragmas(source: str) -> Dict[int, Optional[set]]:
-    return lintcache.parse_pragmas(source, "graftthread")
+    return lintcache.parse_pragmas(source, "graftwire")
 
 
 def _apply_pragmas(findings: List[Finding],
@@ -83,69 +83,85 @@ def _apply_pragmas(findings: List[Finding],
 
 def scan_file(path: str, rules=None) -> Dict:
     """One file's full scan: ``{"findings": [per-file findings, pragma-
-    filtered], "edges": [lock-graph edges], "pragmas": {line: rules}}``.
-    T3 runs over the file's own edges ONLY in :func:`lint_file`; here
-    the edges are returned raw for the driver's global pass."""
-    from .rules import ALL_RULES, lock_order
-    rules = ALL_RULES if rules is None else rules
+    filtered], "facts": WireFacts, "pragmas": {line: rules}}``. The
+    cross-file rules (W1/W2) run over the facts ONLY in
+    :func:`lint_file` / :func:`lint_paths`; here they are returned raw
+    for the driver's union pass."""
+    from .rules import PER_FILE_RULES
+    rules = None if rules is None else list(rules)
     try:
         with open(path, encoding="utf-8") as f:
             source = f.read()
     except OSError as exc:
         return {"findings": [Finding(path, 0, 0, "E0", "unreadable",
                                      str(exc))],
-                "edges": [], "pragmas": {}}
+                "facts": WireFacts(), "pragmas": {}}
     try:
-        analysis = ThreadAnalysis(ast.parse(source, filename=path),
-                                  source, path)
+        analysis = WireAnalysis(path, ast.parse(source, filename=path))
     except SyntaxError as exc:
         return {"findings": [Finding(path, exc.lineno or 0,
                                      exc.offset or 0, "E1",
                                      "syntax-error",
                                      exc.msg or "syntax error")],
-                "edges": [], "pragmas": {}}
+                "facts": WireFacts(), "pragmas": {}}
     pragmas = parse_pragmas(source)
-    findings: List[Finding] = [
-        Finding(path, line, col, "E2", "bad-declaration", msg)
-        for line, col, msg in analysis.decl_errors]
-    for mod in rules:
-        if mod is lock_order:
-            continue          # global pass; lint_file adds it per-file
-        findings.extend(mod.check(analysis))
-    active_edges = (lock_order.edges(analysis)
-                    if lock_order in rules else [])
+    registry = schema_registry.registry_for(path)
+    findings: List[Finding] = list(analysis.errors)
+    for mod in PER_FILE_RULES:
+        if rules is not None and mod not in rules:
+            continue
+        findings.extend(mod.check(analysis, registry))
     return {"findings": _apply_pragmas(findings, pragmas),
-            "edges": active_edges, "pragmas": pragmas}
+            "facts": analysis.facts(), "pragmas": pragmas}
+
+
+def _union_findings(entries: Dict[str, Dict], files: Sequence[str],
+                    rules=None) -> List[Finding]:
+    """The global W1/W2 pass over every scanned file's facts, each
+    finding pragma-filtered against its ANCHOR file's pragma lines."""
+    from .rules import GLOBAL_RULES
+    facts_by_path = {path: entries[path]["facts"]
+                     for path in files if path in entries}
+    out: List[Finding] = []
+    for mod in GLOBAL_RULES:
+        if rules is not None and mod not in rules:
+            continue
+        for finding in mod.check_union(facts_by_path):
+            pragmas = entries.get(finding.path, {}).get("pragmas", {})
+            out.extend(_apply_pragmas([finding], pragmas))
+    return out
 
 
 def lint_file(path: str, rules=None) -> List[Finding]:
-    """All findings for ONE file — per-file rules plus T3 over the
-    file's own edge set (the fixture/unit mode; the repo gate's T3 is
-    global, via :func:`lint_paths`)."""
-    from .rules import ALL_RULES, lock_order
-    rules = ALL_RULES if rules is None else rules
+    """All findings for ONE file — per-file rules plus W1/W2 over the
+    file's own facts (the fixture/unit mode; the repo gate's verdict
+    is the union, via :func:`lint_paths`)."""
     entry = scan_file(path, rules)
     findings = list(entry["findings"])
-    if lock_order in rules and entry["edges"]:
-        cyc = [f for f, _ in lock_order.cycle_findings(entry["edges"])]
-        findings.extend(_apply_pragmas(cyc, entry["pragmas"]))
+    findings.extend(_union_findings({path: entry}, [path], rules))
     return sorted(findings, key=lambda f: (f.line, f.col, f.rule))
 
 
 # -- parse cache + parallel walk (tools/lintcache machinery) --------------
 
 def _rules_signature() -> str:
-    """Content hash of the graftthread package PLUS the shared
-    lintcache module — a cache must never outlive the code that
-    produced it."""
-    return lintcache.package_signature(
+    """Content hash of the graftwire package PLUS the shared lintcache
+    module PLUS the schema registry the W6 verdicts were made against
+    — a cache must never outlive the code OR the schema that produced
+    it."""
+    sig = lintcache.package_signature(
         os.path.dirname(os.path.abspath(__file__)),
         lintcache.__file__)
+    schema_path = schema_registry.find_schema(
+        os.path.join(os.getcwd(), "_probe_"))
+    digest = (lintcache.file_digest(schema_path)
+              if schema_path else None)
+    return f"{sig}:{digest or 'no-schema'}"
 
 
 def default_cache_path() -> str:
-    return lintcache.default_cache_path("RAFT_GRAFTTHREAD_CACHE",
-                                        "graftthread_cache.json")
+    return lintcache.default_cache_path("RAFT_GRAFTWIRE_CACHE",
+                                        "graftwire_cache.json")
 
 
 def _rule_ids(rules) -> Optional[List[str]]:
@@ -161,14 +177,14 @@ def _rules_from_ids(ids: Optional[List[str]]):
 
 def _entry_to_json(entry: Dict) -> Dict:
     return {"findings": [f.__dict__ for f in entry["findings"]],
-            "edges": entry["edges"],
+            "facts": entry["facts"].to_json(),
             "pragmas": {str(k): (sorted(v) if v is not None else None)
                         for k, v in entry["pragmas"].items()}}
 
 
 def _entry_from_json(data: Dict) -> Dict:
     return {"findings": [Finding(**d) for d in data["findings"]],
-            "edges": data["edges"],
+            "facts": WireFacts.from_json(data["facts"]),
             "pragmas": {int(k): (set(v) if v is not None else None)
                         for k, v in data["pragmas"].items()}}
 
@@ -179,15 +195,43 @@ def _scan_one(job: Tuple[str, Optional[List[str]]]) -> Dict:
     return scan_file(path, rules=_rules_from_ids(ids))
 
 
+def _w7_findings(files: Sequence[str], entries: Dict[str, Dict],
+                 rules=None) -> List[Finding]:
+    """Repo-level W7 whenever the scanned set includes the fault
+    seam; findings pragma-filter against their anchor file (which may
+    be OUTSIDE the scanned set, e.g. cli/serve_bench.py — parse its
+    pragmas fresh)."""
+    from .rules import fault_coverage
+    if rules is not None and fault_coverage not in rules:
+        return []
+    trigger = next((p for p in files
+                    if os.path.normpath(p).endswith(FAULTS_SUFFIX)), None)
+    if trigger is None:
+        return []
+    repo_root = os.path.normpath(trigger)
+    for _ in range(3):
+        repo_root = os.path.dirname(repo_root)
+    out: List[Finding] = []
+    for finding in fault_coverage.check_repo(repo_root or "."):
+        pragmas = entries.get(finding.path, {}).get("pragmas")
+        if pragmas is None:
+            try:
+                with open(finding.path, encoding="utf-8") as f:
+                    pragmas = parse_pragmas(f.read())
+            except OSError:
+                pragmas = {}
+        out.extend(_apply_pragmas([finding], pragmas))
+    return out
+
+
 def lint_paths(paths: Sequence[str], rules=None,
                cache_path: Optional[str] = None,
                jobs: int = 1) -> List[Finding]:
     """Scan, optionally with the shared content-hash parse cache and a
     process pool over cache misses (cache entries key on file hash +
-    active rule ids under the package signature — identical discipline
-    to graftlint's). Per-file findings come first in path order, then
-    the global T3 cycle findings."""
-    from .rules import lock_order
+    active rule ids under the package+schema signature — identical
+    discipline to graftlint's). Per-file findings come first in path
+    order, then the global W1/W2 union findings, then W7."""
     files = collect_files(paths)
     entries: Dict[str, Dict] = {}
     misses: List[str] = []
@@ -235,17 +279,8 @@ def lint_paths(paths: Sequence[str], rules=None,
     out: List[Finding] = []
     for path in files:
         out.extend(entries.get(path, {}).get("findings", []))
-
-    # the global T3 pass: union every file's edges, re-run the cycle
-    # check (cheap — no parsing), pragma-filter each cycle finding
-    # against its ANCHOR file's pragma lines
-    if rules is None or any(m is lock_order for m in rules):
-        all_edges = [e for path in files
-                     for e in entries.get(path, {}).get("edges", [])]
-        for finding, _anchor in lock_order.cycle_findings(all_edges):
-            pragmas = entries.get(finding.path, {}).get("pragmas", {})
-            if _apply_pragmas([finding], pragmas):
-                out.append(finding)
+    out.extend(_union_findings(entries, files, rules))
+    out.extend(_w7_findings(files, entries, rules))
     return out
 
 
@@ -261,7 +296,7 @@ def load_baseline(path: str) -> Counter:
 
 def write_baseline(path: str, findings: Iterable[Finding]) -> None:
     lintcache.write_baseline(path, (finding_key(f) for f in findings),
-                             "graftthread")
+                             "graftwire")
 
 
 def apply_baseline(findings: List[Finding], baseline: Counter,
@@ -277,12 +312,11 @@ def apply_baseline(findings: List[Finding], baseline: Counter,
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
-        prog="graftthread",
-        description="Thread-safety static analysis for the serving "
-                    "stack (rules T1-T6; see tools/graftthread/"
-                    "rules/). With no paths, scans the serving stack "
-                    "+ supervisor + utils against the shipped "
-                    "baseline.")
+        prog="graftwire",
+        description="Wire-protocol static analysis for the multi-host "
+                    "fleet (rules W1-W7; see tools/graftwire/rules/). "
+                    "With no paths, scans the serving stack + parallel "
+                    "layer + fault seam against the shipped baseline.")
     p.add_argument("paths", nargs="*",
                    help="files and/or directories to check (default: "
                         f"{' '.join(DEFAULT_PATHS)}, with the shipped "
@@ -295,23 +329,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--write-baseline", metavar="JSON",
                    help="write current findings as the new baseline "
                         "and exit 0")
-    p.add_argument("--rules", metavar="T1,T3,...",
+    p.add_argument("--rules", metavar="W1,W3,...",
                    help="run only these rule ids")
     p.add_argument("--jobs", type=int, default=1, metavar="N",
                    help="scan cache misses across N processes "
                         "(default 1: in-process)")
     p.add_argument("--cache", metavar="JSON", default=None,
                    help="parse-cache file (default: "
-                        "$RAFT_GRAFTTHREAD_CACHE or "
-                        "~/.cache/raft_tpu/graftthread_cache.json); "
+                        "$RAFT_GRAFTWIRE_CACHE or "
+                        "~/.cache/raft_tpu/graftwire_cache.json); "
                         "same content-hash + package-signature "
-                        "discipline as graftlint's cache")
+                        "discipline as the other tiers' caches")
     p.add_argument("--no-cache", action="store_true",
                    help="scan every file from scratch")
     args = p.parse_args(argv)
 
     if args.jobs < 1:
-        print("graftthread: --jobs must be >= 1", file=sys.stderr)
+        print("graftwire: --jobs must be >= 1", file=sys.stderr)
         return 2
     cache_path = None if args.no_cache \
         else (args.cache or default_cache_path())
@@ -322,7 +356,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         paths = list(DEFAULT_PATHS)
         if baseline_path is None and not args.write_baseline:
             # the argument-less gate applies the shipped baseline, so
-            # `python -m tools.graftthread --json` IS the tier-1 gate
+            # `python -m tools.graftwire --json` IS the tier-1 gate
             baseline_path = os.path.join(
                 os.path.dirname(os.path.abspath(__file__)),
                 "baseline.json")
@@ -334,14 +368,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         rules = [m for m in ALL_RULES if m.RULE in want]
         unknown = want - {m.RULE for m in rules}
         if unknown:
-            print(f"graftthread: unknown rule(s): {sorted(unknown)}",
+            print(f"graftwire: unknown rule(s): {sorted(unknown)}",
                   file=sys.stderr)
             return 2
 
     if args.write_baseline and args.rules:
         # a rule-filtered regenerate would silently drop every other
         # rule's grandfathered entries and fail the next full gate run
-        print("graftthread: refusing --write-baseline with --rules — "
+        print("graftwire: refusing --write-baseline with --rules — "
               "regenerate from a full-rule run over the gate's paths",
               file=sys.stderr)
         return 2
@@ -354,7 +388,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         write_baseline(args.write_baseline,
                        [f for f in findings
                         if not f.rule.startswith("E")])
-        print(f"graftthread: wrote {len(findings) - len(hard_errors)} "
+        print(f"graftwire: wrote {len(findings) - len(hard_errors)} "
               f"finding(s) to {args.write_baseline} — remember the "
               "discipline: the SHIPPED baseline stays EMPTY (fix or "
               "pragma-with-justification instead)", file=sys.stderr)
@@ -365,7 +399,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         try:
             baseline = load_baseline(baseline_path)
         except (OSError, ValueError, KeyError) as exc:
-            print(f"graftthread: unreadable baseline "
+            print(f"graftwire: unreadable baseline "
                   f"{baseline_path}: {exc}", file=sys.stderr)
             return 2
         if rules is not None:
@@ -391,13 +425,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for f in findings:
             print(f.render())
         if findings:
-            print(f"graftthread: {len(findings)} new finding(s)",
+            print(f"graftwire: {len(findings)} new finding(s)",
                   file=sys.stderr)
     if stale:
         for k in stale:
-            print(f"graftthread: stale baseline entry {k[0]} [{k[1]}] "
+            print(f"graftwire: stale baseline entry {k[0]} [{k[1]}] "
                   f"{k[2]!r}", file=sys.stderr)
-        print(f"graftthread: {len(stale)} stale baseline entr(y/ies) — "
+        print(f"graftwire: {len(stale)} stale baseline entr(y/ies) — "
               "regenerate with --write-baseline so it cannot "
               "grandfather a future reintroduction", file=sys.stderr)
     return 1 if (findings or stale) else 0

@@ -1,0 +1,349 @@
+"""Per-module wire model: the `GRAFTWIRE` declaration literal plus the
+facts every W-rule consumes (client calls, worker handler tables, lock
+scopes, event emissions, raw socket touches).
+
+The declaration is the module's side of the wire contract — the same
+move as graftthread's `GRAFTTHREAD` literal: the analyzer trusts what
+the module SAYS about itself, then checks that the code matches.
+
+```python
+GRAFTWIRE = {
+    "idempotent": ("ping", "stats"),       # safe to re-send (W2)
+    "wire_locks": ("_lock",),              # lock IS the per-conn
+                                           #   serialization (W3)
+    "locks": ("_reg_lock",),               # extra lock-ish attrs (W3)
+    "verdicts": ("_wedge_host",),          # host-verdict fns (W4)
+    "consequences": ("poison",),           # must precede settles (W4)
+    "settles": ("_failover_requeue",),     # extra future-settlers (W4)
+    "framed_helpers": ("_send_msg",),      # blessed raw-socket fns (W6)
+    "event_emitters": ("_emit",),          # record_event wrappers (W6)
+}
+```
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftwire.finding import Finding
+
+#: every key GRAFTWIRE accepts, with its empty default
+DECL_DEFAULTS = {
+    "idempotent": (),
+    "wire_locks": (),
+    "locks": (),
+    "verdicts": (),
+    "consequences": (),
+    "settles": (),
+    "framed_helpers": (),
+    "event_emitters": (),
+}
+
+#: attribute names that look like a lock/serialization guard when used
+#: as a context manager (`with self._lock:`), mirroring graftthread
+LOCKISH = re.compile(r"(^|_)(lock|mutex|guard|sem|semaphore|cond)s?$",
+                     re.IGNORECASE)
+
+#: calls that settle a caller-visible future (W4's "too early" side)
+SETTLE_NAMES = {"settle_future", "set_result", "set_exception"}
+
+#: raw-socket verbs that put bytes on / pull bytes off the wire (W6);
+#: shutdown/close/bind are lifecycle, not framing, and stay legal
+SOCKET_VERBS = {"send", "sendall", "sendto", "recv", "recvfrom",
+                "recv_into"}
+
+#: receiver name segments that mark a socket object
+SOCKETISH = re.compile(r"(^|_)(sock|socket|conn|connection)s?(_|$)",
+                       re.IGNORECASE)
+
+#: subprocess-ish blocking waits for W3
+SUBPROCESS_WAITS = {"run", "communicate", "check_output", "check_call",
+                    "wait"}
+PROCESSISH = re.compile(r"(^|_)(proc|process|popen|child|worker)s?(_|$)",
+                        re.IGNORECASE)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`self.fleet._lock` -> "self.fleet._lock"; None for anything that
+    is not a plain Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def segments(name: str) -> List[str]:
+    return name.split(".")
+
+
+@dataclass
+class WireCall:
+    """One `<recv>.call("method", payload...)` (or `_call`) client-side
+    wire invocation with a string-constant method name."""
+    method: str
+    line: int
+    col: int
+    has_request_id: bool
+    func: str          # enclosing function qualname ("" at module level)
+
+
+@dataclass
+class Handler:
+    """One `_m_<method>` entry in a worker handler table (a class that
+    also defines `handle`)."""
+    method: str
+    line: int
+    col: int
+    cls: str
+
+
+@dataclass
+class EventEmit:
+    """One record_event(...) / declared-emitter call. `match` is
+    ("exact", name) for a string constant, ("prefix", p) for
+    `"p" + expr` first args."""
+    match: Tuple[str, str]
+    line: int
+    col: int
+
+
+@dataclass
+class WireFacts:
+    """The cacheable per-file summary the cross-file rules (W1/W2)
+    union over — plain JSON-able payload, like graftthread's edges."""
+    calls: List[dict] = field(default_factory=list)
+    handlers: List[dict] = field(default_factory=list)
+    idempotent: List[str] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"calls": self.calls, "handlers": self.handlers,
+                "idempotent": self.idempotent}
+
+    @classmethod
+    def from_json(cls, blob: dict) -> "WireFacts":
+        return cls(calls=list(blob.get("calls", ())),
+                   handlers=list(blob.get("handlers", ())),
+                   idempotent=list(blob.get("idempotent", ())))
+
+
+class WireAnalysis:
+    """One parsed module + its GRAFTWIRE declarations + extracted wire
+    facts. Rule modules stay thin: they read these tables."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.tree = tree
+        self.errors: List[Finding] = []
+        self.decl: Dict[str, Tuple[str, ...]] = {
+            k: tuple(v) for k, v in DECL_DEFAULTS.items()}
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self._parse_declarations()
+        self.calls: List[WireCall] = []
+        self.handlers: List[Handler] = []
+        self.emits: List[EventEmit] = []
+        self._collect()
+
+    # -- declarations -------------------------------------------------------
+
+    def _parse_declarations(self) -> None:
+        for node in self.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "GRAFTWIRE"):
+                continue
+            try:
+                value = ast.literal_eval(node.value)
+            except (ValueError, TypeError, SyntaxError):
+                self.errors.append(Finding(
+                    self.path, node.lineno, node.col_offset, "E2",
+                    "bad-declaration",
+                    "GRAFTWIRE must be a literal dict of tuples/lists "
+                    "of strings"))
+                return
+            if not isinstance(value, dict):
+                self.errors.append(Finding(
+                    self.path, node.lineno, node.col_offset, "E2",
+                    "bad-declaration", "GRAFTWIRE must be a dict"))
+                return
+            for key, val in value.items():
+                if key not in DECL_DEFAULTS:
+                    self.errors.append(Finding(
+                        self.path, node.lineno, node.col_offset, "E2",
+                        "bad-declaration",
+                        f"unknown GRAFTWIRE key {key!r} (known: "
+                        f"{', '.join(sorted(DECL_DEFAULTS))})"))
+                    continue
+                if (not isinstance(val, (list, tuple)) or
+                        not all(isinstance(x, str) for x in val)):
+                    self.errors.append(Finding(
+                        self.path, node.lineno, node.col_offset, "E2",
+                        "bad-declaration",
+                        f"GRAFTWIRE[{key!r}] must be a tuple of "
+                        "strings"))
+                    continue
+                self.decl[key] = tuple(val)
+
+    # -- scope helpers (graftthread's walk model) ---------------------------
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts))
+
+    def walk_same_scope(self, node: ast.AST):
+        """Yield descendants of `node` without crossing into nested
+        function/class definitions (their bodies run later, under their
+        own locks)."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            child = stack.pop()
+            yield child
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(child))
+
+    # -- lock scopes --------------------------------------------------------
+
+    def is_lockish(self, name: str) -> bool:
+        segs = segments(name)
+        if any(LOCKISH.search(s) for s in segs):
+            return True
+        return any(s in self.decl["locks"] or s in
+                   self.decl["wire_locks"] for s in segs)
+
+    def is_wire_lock(self, name: str) -> bool:
+        return any(s in self.decl["wire_locks"] for s in segments(name))
+
+    def held_lock_scopes(self):
+        """Yield (lock_name, with_node) for every `with <lockish>:`
+        context in the module."""
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                expr = item.context_expr
+                # `with lock:` or `with self._lock:` — also unwrap
+                # `lock.acquire_timeout(...)`-style calls
+                target = expr.func if isinstance(expr, ast.Call) else expr
+                name = dotted(target)
+                if name and self.is_lockish(name):
+                    yield name, node
+
+    # -- fact extraction ----------------------------------------------------
+
+    def _collect(self) -> None:
+        emitter_names = {"record_event"} | set(self.decl["event_emitters"])
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self._collect_handlers(node)
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            # client wire call: <recv>.call("m", ...) / <recv>._call("m", ...)
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in ("call", "_call")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                func = self.enclosing_function(node)
+                self.calls.append(WireCall(
+                    method=node.args[0].value, line=node.lineno,
+                    col=node.col_offset,
+                    has_request_id=self._carries_request_id(node),
+                    func=self.qualname(func) if func else ""))
+            # event emission: record_event("kind", ...) or a declared
+            # wrapper like self._emit("kind", ...)
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name in emitter_names and node.args:
+                match = self._event_match(node.args[0])
+                if match is not None:
+                    self.emits.append(EventEmit(
+                        match=match, line=node.lineno,
+                        col=node.col_offset))
+
+    def _collect_handlers(self, cls: ast.ClassDef) -> None:
+        """A worker handler table is a class that defines `handle` and
+        dispatches to `_m_<method>` methods (the PR-18 HostWorker
+        shape)."""
+        names = {n.name for n in cls.body
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))}
+        if "handle" not in names:
+            return
+        for n in cls.body:
+            if (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n.name.startswith("_m_")):
+                self.handlers.append(Handler(
+                    method=n.name[len("_m_"):], line=n.lineno,
+                    col=n.col_offset, cls=cls.name))
+
+    @staticmethod
+    def _carries_request_id(call: ast.Call) -> bool:
+        """True when the call's payload visibly carries a request id —
+        a `request_id=` keyword anywhere, or a dict argument with a
+        "request_id" key."""
+        for kw in call.keywords:
+            if kw.arg == "request_id":
+                return True
+        for arg in list(call.args[1:]) + [kw.value for kw in
+                                          call.keywords]:
+            if isinstance(arg, ast.Dict):
+                for k in arg.keys:
+                    if (isinstance(k, ast.Constant)
+                            and k.value == "request_id"):
+                        return True
+            if (isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Name)
+                    and arg.func.id == "dict"):
+                if any(kw.arg == "request_id" for kw in arg.keywords):
+                    return True
+        return False
+
+    @staticmethod
+    def _event_match(arg: ast.AST) -> Optional[Tuple[str, str]]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return ("exact", arg.value)
+        # `"breaker_" + new` — a constant prefix is still checkable
+        if (isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add)
+                and isinstance(arg.left, ast.Constant)
+                and isinstance(arg.left.value, str)):
+            return ("prefix", arg.left.value)
+        return None          # fully dynamic: the runtime drill owns it
+
+    # -- cacheable summary --------------------------------------------------
+
+    def facts(self) -> WireFacts:
+        return WireFacts(
+            calls=[{"method": c.method, "line": c.line, "col": c.col,
+                    "request_id": c.has_request_id, "func": c.func}
+                   for c in self.calls],
+            handlers=[{"method": h.method, "line": h.line,
+                       "col": h.col, "cls": h.cls}
+                      for h in self.handlers],
+            idempotent=list(self.decl["idempotent"]))

@@ -1,0 +1,24 @@
+"""The one record type every graftwire rule emits."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str      # as given on the command line (relative in CI)
+    line: int      # 1-based, node start line
+    col: int       # 0-based
+    rule: str      # "W1".."W7"
+    name: str      # kebab-case rule name, e.g. "method-table-drift"
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule}[{self.name}] {self.message}")
+
+    def key(self, code_line: str) -> tuple:
+        """Baseline identity: line NUMBERS drift across edits, the
+        (path, rule, source text) triple mostly doesn't."""
+        return (self.path.replace("\\", "/"), self.rule, code_line.strip())

@@ -1,0 +1,21 @@
+"""graftwire rule registry.
+
+Per-file rules see one module's :class:`WireAnalysis`; the cross-file
+rules (W1/W2) see the UNION of every scanned file's wire facts — a
+client and its worker live in different modules, so method-table and
+idempotency drift only close in the global pass (graftthread's T3
+union-graph move). W7 is repo-level: it cross-references the armed
+fault sites against the chaos drills.
+"""
+
+from . import (blocking_wire, fault_coverage, idempotency, method_table,
+               retry_loop, schema_drift, verdicts)
+
+#: rules that run over one file's analysis in scan_file
+PER_FILE_RULES = [blocking_wire, verdicts, retry_loop, schema_drift]
+
+#: rules that run over the union of per-file facts in lint_paths
+GLOBAL_RULES = [method_table, idempotency]
+
+ALL_RULES = [method_table, idempotency, blocking_wire, verdicts,
+             retry_loop, schema_drift, fault_coverage]

@@ -1,0 +1,97 @@
+"""W3: blocking wire call under a held lock — graftthread's T1
+extended across the process seam.
+
+A `transport.call(...)`, framed-socket helper, raw socket send/recv,
+connect, or subprocess wait lexically inside `with <lockish>:` wedges
+every thread contending that lock for a full network round-trip (or
+forever, against a dead peer).
+
+Exemption: a lock declared in `GRAFTWIRE["wire_locks"]` IS the
+transport's serialization contract (one request per connection, the
+PR-18 SocketTransport design) — holding it across the I/O is the
+point, not the bug. Scheduler/registry/fleet locks get no such pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.graftwire.declarations import (PROCESSISH, SOCKET_VERBS,
+                                          SOCKETISH, SUBPROCESS_WAITS,
+                                          WireAnalysis, dotted,
+                                          segments)
+from tools.graftwire.finding import Finding
+
+RULE = "W3"
+NAME = "wire-call-under-lock"
+
+FRAMED_IO = {"_send_msg", "_recv_msg", "_recv_exact"}
+
+
+def _socketish(name: Optional[str]) -> bool:
+    return name is not None and any(SOCKETISH.search(s)
+                                    for s in segments(name))
+
+
+def _processish(name: Optional[str]) -> bool:
+    if name is None:
+        return False
+    segs = segments(name)
+    return "subprocess" in segs or any(PROCESSISH.search(s)
+                                       for s in segs)
+
+
+def blocking_desc(node: ast.AST) -> Optional[str]:
+    """A human description of the wire-blocking operation `node`
+    performs, or None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        recv = dotted(fn.value)
+        if (fn.attr in ("call", "_call") and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            return f"transport call {node.args[0].value!r}"
+        if fn.attr in SOCKET_VERBS and _socketish(recv):
+            return f"raw socket .{fn.attr}()"
+        if fn.attr == "connect" and _socketish(recv):
+            return "socket connect"
+        if fn.attr == "create_connection" and recv is not None \
+                and "socket" in segments(recv):
+            return "socket.create_connection()"
+        if fn.attr in FRAMED_IO:
+            return f"framed socket I/O {fn.attr}()"
+        if fn.attr in SUBPROCESS_WAITS and _processish(recv):
+            return f"subprocess wait .{fn.attr}()"
+        return None
+    if isinstance(fn, ast.Name) and fn.id in FRAMED_IO:
+        return f"framed socket I/O {fn.id}()"
+    return None
+
+
+def check(analysis: WireAnalysis, registry=None) -> List[Finding]:
+    findings: List[Finding] = []
+    seen = set()
+    for lock_name, with_node in analysis.held_lock_scopes():
+        if analysis.is_wire_lock(lock_name):
+            continue
+        for child in analysis.walk_same_scope(with_node):
+            desc = blocking_desc(child)
+            if desc is None:
+                continue
+            key = (child.lineno, child.col_offset)
+            if key in seen:
+                continue          # nested lock scopes: report once
+            seen.add(key)
+            findings.append(Finding(
+                analysis.path, child.lineno, child.col_offset, RULE,
+                NAME,
+                f"{desc} while holding {lock_name!r} — a wire "
+                "round-trip (or a dead peer) wedges every thread "
+                "behind this lock; move the I/O outside the critical "
+                "section or declare the lock in "
+                "GRAFTWIRE['wire_locks'] if serialization is the "
+                "contract"))
+    return findings

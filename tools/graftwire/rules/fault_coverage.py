@@ -1,0 +1,165 @@
+"""W7: fault-site coverage — every armed fault site must be drilled.
+
+Three-way cross-reference, with `KNOWN_SITES` in
+`raft_tpu/testing/faults.py` as the single source of truth:
+
+1. a site armed in code (`fault_point`/`fault_file`/`fault_data` with
+   a string-constant name) but missing from `KNOWN_SITES` — the
+   registry drifted;
+2. a `KNOWN_SITES` entry no code ever arms — a stale registry row that
+   makes the drill matrix claim more than it covers;
+3. a known, armed site that no chaos plan or `CHAOS_SITES` list under
+   `tests/` / `raft_tpu/cli/` ever draws — an undrilled failure mode
+   (today that is convention; this makes it a finding).
+
+"Drawn" means the site name appears as a non-docstring string constant
+somewhere under the drill roots — chaos plans pass sites as literals
+(`CHAOS_SITES` tuples, `faults.arm([{"site": ...}])`, env-plan
+strings), so constant-scanning is the honest static proxy for "some
+drill can select this site".
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Sequence, Set, Tuple
+
+from tools import lintcache
+from tools.graftwire.finding import Finding
+
+RULE = "W7"
+NAME = "undrilled-fault-site"
+
+ARMING_FNS = {"fault_point", "fault_file", "fault_data"}
+
+FAULTS_REL = os.path.join("raft_tpu", "testing", "faults.py")
+CODE_ROOTS = ("raft_tpu",)
+DRILL_ROOTS = ("tests", os.path.join("raft_tpu", "cli"))
+
+
+def _parse(path: str):
+    try:
+        with open(path, encoding="utf-8") as f:
+            return ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+
+
+def known_sites(faults_path: str) -> Tuple[Set[str], int]:
+    """KNOWN_SITES keys + the assignment's line (finding anchor for
+    never-armed entries)."""
+    tree = _parse(faults_path)
+    if tree is None:
+        return set(), 0
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "KNOWN_SITES"
+                and isinstance(node.value, ast.Dict)):
+            keys = {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+            return keys, node.lineno
+    return set(), 0
+
+
+def armed_sites(roots: Sequence[str]) -> Dict[str, List[Tuple]]:
+    """site -> [(path, line, col), ...] for every string-constant
+    arming call under `roots`."""
+    armed: Dict[str, List[Tuple]] = {}
+    for path in lintcache.collect_files(list(roots)):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name in ARMING_FNS and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                armed.setdefault(node.args[0].value, []).append(
+                    (path, node.lineno, node.col_offset))
+    return armed
+
+
+def _docstring_constants(tree: ast.Module) -> Set[int]:
+    ids: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant) \
+                    and isinstance(body[0].value.value, str):
+                ids.add(id(body[0].value))
+    return ids
+
+
+def drawn_sites(roots: Sequence[str],
+                candidates: Set[str]) -> Set[str]:
+    """Site names appearing as non-docstring string constants under
+    the drill roots."""
+    drawn: Set[str] = set()
+    for path in lintcache.collect_files(list(roots)):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        doc_ids = _docstring_constants(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value in candidates \
+                    and id(node) not in doc_ids:
+                drawn.add(node.value)
+        if drawn >= candidates:
+            break
+    return drawn
+
+
+def check_repo(repo_root: str, faults_rel: str = FAULTS_REL,
+               code_roots: Sequence[str] = CODE_ROOTS,
+               drill_roots: Sequence[str] = DRILL_ROOTS,
+               ) -> List[Finding]:
+    def under(rel: str) -> str:
+        return os.path.normpath(os.path.join(repo_root, rel))
+
+    faults_path = under(faults_rel)
+    known, known_line = known_sites(faults_path)
+    armed = armed_sites([under(r) for r in code_roots
+                         if os.path.exists(under(r))])
+    # the arming call inside faults.py's own machinery/doctests is the
+    # mechanism, not a site
+    armed = {s: [site for site in sites
+                 if os.path.normpath(site[0]) != faults_path]
+             for s, sites in armed.items()}
+    armed = {s: sites for s, sites in armed.items() if sites}
+    drawn = drawn_sites([under(r) for r in drill_roots
+                         if os.path.exists(under(r))],
+                        known | set(armed))
+
+    findings: List[Finding] = []
+    for site in sorted(armed):
+        path, line, col = min(armed[site])
+        if site not in known:
+            findings.append(Finding(
+                path, line, col, RULE, NAME,
+                f"fault site {site!r} is armed here but missing from "
+                f"KNOWN_SITES in {faults_rel} — register it so plans "
+                "validate and W7 can audit its drills"))
+        elif site not in drawn:
+            findings.append(Finding(
+                path, line, col, RULE, NAME,
+                f"armed fault site {site!r} is drawn by no chaos plan "
+                f"or CHAOS_SITES list under {' / '.join(drill_roots)} "
+                "— an undrilled failure mode"))
+    for site in sorted(known - set(armed)):
+        findings.append(Finding(
+            faults_path, known_line, 0, RULE, NAME,
+            f"KNOWN_SITES entry {site!r} is never armed by any "
+            "fault_point/fault_file/fault_data call — stale registry "
+            "row"))
+    return findings

@@ -1,0 +1,42 @@
+"""W2: idempotency discipline.
+
+The PR-18 transport contract makes EVERY remote call retryable
+(`TransportError` is always-retryable; failover re-queues in-flight
+work), so every wire method is reachable from a retry path. A method
+must therefore either be declared idempotent in some module's
+`GRAFTWIRE["idempotent"]` (the worker module owns that contract) or
+visibly carry a `request_id` in its payload so the worker can dedup
+the zombie re-send.
+
+Declarations union across scanned files: hosts.py declaring
+`"put_artifact"` idempotent covers aot.py's call site — which is why
+the gate's verdict is the GLOBAL pass, and a client module linted
+alone may fire where the fleet-wide union is clean.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from tools.graftwire.declarations import WireFacts
+from tools.graftwire.finding import Finding
+
+RULE = "W2"
+NAME = "unretryable-call"
+
+
+def check_union(facts_by_path: Dict[str, WireFacts]) -> List[Finding]:
+    idempotent = {m for facts in facts_by_path.values()
+                  for m in facts.idempotent}
+    findings: List[Finding] = []
+    for path, facts in facts_by_path.items():
+        for c in facts.calls:
+            if c["method"] in idempotent or c["request_id"]:
+                continue
+            findings.append(Finding(
+                path, c["line"], c["col"], RULE, NAME,
+                f"remote call {c['method']!r} is retried on "
+                "TransportError but is neither declared in "
+                "GRAFTWIRE['idempotent'] nor carries a request_id — "
+                "a zombie re-send double-applies it"))
+    return findings

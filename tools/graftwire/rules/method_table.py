@@ -1,0 +1,53 @@
+"""W1: method-table drift.
+
+Every method string a transport client `call()`s must be registered in
+a worker handler table (`_m_<method>` on a class with `handle`), and
+every registered handler must have at least one client caller — in the
+UNION of scanned files, because client and worker are different
+modules (scheduler/aot call, hosts.py handles).
+
+Single-file semantics are deliberately conservative: a client-only
+module has no handler table to check against (and vice versa), so the
+rule only fires when the union actually contains the other side. That
+is what makes drift a cross-file-pass-only finding, like graftthread's
+T3 cycles.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from tools.graftwire.declarations import WireFacts
+from tools.graftwire.finding import Finding
+
+RULE = "W1"
+NAME = "method-table-drift"
+
+
+def check_union(facts_by_path: Dict[str, WireFacts]) -> List[Finding]:
+    calls = [(path, c) for path, facts in facts_by_path.items()
+             for c in facts.calls]
+    handlers = [(path, h) for path, facts in facts_by_path.items()
+                for h in facts.handlers]
+    findings: List[Finding] = []
+    if handlers:
+        handled = {h["method"] for _, h in handlers}
+        for path, c in calls:
+            if c["method"] not in handled:
+                findings.append(Finding(
+                    path, c["line"], c["col"], RULE, NAME,
+                    f"client calls wire method {c['method']!r} but no "
+                    f"worker handler table registers "
+                    f"_m_{c['method']} — the call can only raise "
+                    "'unknown method' at runtime"))
+    if calls:
+        called = {c["method"] for _, c in calls}
+        for path, h in handlers:
+            if h["method"] not in called:
+                findings.append(Finding(
+                    path, h["line"], h["col"], RULE, NAME,
+                    f"worker handler _m_{h['method']} "
+                    f"({h['cls']}) is registered but no transport "
+                    f"client calls {h['method']!r} — dead protocol "
+                    "surface (or the caller's method string drifted)"))
+    return findings

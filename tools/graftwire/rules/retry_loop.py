@@ -1,0 +1,118 @@
+"""W5: reconnect/retry loops not routed through
+`utils/retry.backoff_delays`.
+
+A loop that catches transport-transient errors (or re-issues wire
+calls/connects) and paces itself with a hand-rolled `time.sleep(k)` is
+the unbounded-hammer class: constant-rate retries against a dead peer,
+no jitter, thundering herd on recovery. The blessed pacing is
+`backoff_delays(...)` (bounded, factor-grown, jittered) — a sleep
+whose delay visibly comes from `next(<delays>)` or a name assigned
+from `backoff_delays(...)` passes; anything else in such a loop is a
+finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.graftwire.declarations import WireAnalysis
+from tools.graftwire.finding import Finding
+
+RULE = "W5"
+NAME = "unbounded-retry-loop"
+
+#: exception names whose catch marks a loop as a transport-retry loop
+TRANSIENT = {"TransportError", "OSError", "IOError", "ConnectionError",
+             "ConnectionResetError", "ConnectionRefusedError",
+             "BrokenPipeError", "TimeoutError", "timeout"}
+
+#: call attrs that mark the loop body as wire-touching
+WIRE_TOUCH = {"connect", "create_connection", "reopen"}
+
+
+def _exc_names(node: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            names.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            names.add(sub.attr)
+    return names
+
+
+def _is_sleep(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "sleep"
+    return isinstance(fn, ast.Name) and fn.id == "sleep"
+
+
+def _mentions_backoff(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == "backoff_delays":
+            return True
+        if isinstance(sub, ast.Attribute) and \
+                sub.attr == "backoff_delays":
+            return True
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Name) and sub.func.id == "next":
+            return True
+    return False
+
+
+def _blessed_names(scope: ast.AST, analysis: WireAnalysis) -> Set[str]:
+    """Names assigned (anywhere in the enclosing scope) from a
+    backoff-derived expression — `delays = backoff_delays(...)`,
+    `delay = next(delays)`."""
+    names: Set[str] = set()
+    for sub in analysis.walk_same_scope(scope):
+        if isinstance(sub, ast.Assign) and _mentions_backoff(sub.value):
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+def check(analysis: WireAnalysis, registry=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, (ast.While, ast.For)):
+            continue
+        body = list(analysis.walk_same_scope(node))
+        sleeps = [c for c in body
+                  if isinstance(c, ast.Call) and _is_sleep(c)]
+        if not sleeps:
+            continue
+        retryish = False
+        for sub in body:
+            if isinstance(sub, ast.ExceptHandler) and sub.type and \
+                    _exc_names(sub.type) & TRANSIENT:
+                retryish = True
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute):
+                if sub.func.attr in WIRE_TOUCH:
+                    retryish = True
+                if sub.func.attr in ("call", "_call") and sub.args \
+                        and isinstance(sub.args[0], ast.Constant) \
+                        and isinstance(sub.args[0].value, str):
+                    retryish = True
+        if not retryish:
+            continue
+        scope = analysis.enclosing_function(node) or analysis.tree
+        blessed = _blessed_names(scope, analysis)
+        for sleep in sleeps:
+            arg = sleep.args[0] if sleep.args else None
+            if arg is not None:
+                if _mentions_backoff(arg):
+                    continue
+                if isinstance(arg, ast.Name) and arg.id in blessed:
+                    continue
+            findings.append(Finding(
+                analysis.path, sleep.lineno, sleep.col_offset, RULE,
+                NAME,
+                "retry/reconnect loop paced by a hand-rolled sleep — "
+                "route the delay through "
+                "raft_tpu.utils.retry.backoff_delays(...) (bounded, "
+                "jittered) instead of a constant-rate hammer"))
+    return findings

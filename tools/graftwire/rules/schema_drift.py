@@ -1,0 +1,86 @@
+"""W6: wire-schema drift.
+
+Everything that crosses the wire or lands in metrics.jsonl must
+correspond to a `serving/schema.py` registry entry:
+
+- `record_event("kind", ...)` (and declared `event_emitters` wrappers)
+  with a kind absent from `EVENT_FIELDS` — the runtime validator would
+  reject the line, but only when a drill happens to emit it; W6 makes
+  it a parse-time finding.
+- wire method strings (client calls AND `_m_` handler entries) absent
+  from the `WIRE_METHODS` registry — the payload contract exists only
+  in two function bodies otherwise.
+- raw `sock.send`/`recv` outside the blessed length-framed helpers
+  (`GRAFTWIRE["framed_helpers"]`) — unframed bytes are how protocol
+  drift becomes a hang instead of an error.
+
+Constant-prefix emissions (`"breaker_" + state`) pass when any
+registered event carries the prefix; fully dynamic kinds are left to
+the runtime drill (`tests/test_serving_schema.py`), W6's dynamic twin.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.graftwire.declarations import (SOCKET_VERBS, SOCKETISH,
+                                          WireAnalysis, dotted,
+                                          segments)
+from tools.graftwire.finding import Finding
+
+RULE = "W6"
+NAME = "wire-schema-drift"
+
+
+def _socketish(name: Optional[str]) -> bool:
+    return name is not None and any(SOCKETISH.search(s)
+                                    for s in segments(name))
+
+
+def check(analysis: WireAnalysis, registry=None) -> List[Finding]:
+    findings: List[Finding] = []
+    if registry is not None:
+        for e in analysis.emits:
+            if not registry.event_declared(e.match):
+                kind, value = e.match
+                what = (f"event {value!r}" if kind == "exact"
+                        else f"event prefix {value!r}")
+                findings.append(Finding(
+                    analysis.path, e.line, e.col, RULE, NAME,
+                    f"{what} has no serving/schema.py EVENT_FIELDS "
+                    "entry — the metrics validator rejects the line "
+                    "at the first drill that emits it"))
+        if registry.methods:
+            for c in analysis.calls:
+                if c.method not in registry.methods:
+                    findings.append(Finding(
+                        analysis.path, c.line, c.col, RULE, NAME,
+                        f"wire method {c.method!r} has no "
+                        "serving/schema.py WIRE_METHODS entry — the "
+                        "payload contract lives only in the two "
+                        "function bodies"))
+            for h in analysis.handlers:
+                if h.method not in registry.methods:
+                    findings.append(Finding(
+                        analysis.path, h.line, h.col, RULE, NAME,
+                        f"handler _m_{h.method} has no "
+                        "serving/schema.py WIRE_METHODS entry — "
+                        "register the method's payload keys"))
+    framed = set(analysis.decl["framed_helpers"])
+    for node in ast.walk(analysis.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in SOCKET_VERBS
+                and _socketish(dotted(node.func.value))):
+            continue
+        fn = analysis.enclosing_function(node)
+        if fn is not None and fn.name in framed:
+            continue
+        findings.append(Finding(
+            analysis.path, node.lineno, node.col_offset, RULE, NAME,
+            f"raw socket .{node.func.attr}() outside a blessed "
+            "length-framed helper (GRAFTWIRE['framed_helpers']) — "
+            "unframed bytes turn protocol drift into a hang, not an "
+            "error"))
+    return findings

@@ -1,0 +1,71 @@
+"""W4: consequences-before-futures across host verdicts —
+graftthread's T6 line-order dominance, applied to the fleet seam.
+
+A declared host-verdict function (`GRAFTWIRE["verdicts"]`) decides a
+host is gone. If it settles caller-visible futures (`settle_future` /
+`set_result` / `set_exception` / declared extras) BEFORE the declared
+consequences (quarantine, placement mark, transport poison, breaker
+record), a woken caller can re-submit into the dead lane — the
+zombie-host window PR 18's `_wedge_host` closes by ordering
+consequences first.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graftwire.declarations import SETTLE_NAMES, WireAnalysis
+from tools.graftwire.finding import Finding
+
+RULE = "W4"
+NAME = "settle-before-consequence"
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def check(analysis: WireAnalysis, registry=None) -> List[Finding]:
+    verdicts = set(analysis.decl["verdicts"])
+    if not verdicts:
+        return []
+    consequences = set(analysis.decl["consequences"])
+    settles = SETTLE_NAMES | set(analysis.decl["settles"])
+    findings: List[Finding] = []
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        if node.name not in verdicts:
+            continue
+        settle_sites = []
+        consequence_lines = []
+        for child in analysis.walk_same_scope(node):
+            if not isinstance(child, ast.Call):
+                continue
+            name = _call_name(child)
+            if name in settles:
+                settle_sites.append(child)
+            elif name in consequences:
+                consequence_lines.append(child.lineno)
+        if not settle_sites:
+            continue
+        first = min(settle_sites, key=lambda c: (c.lineno,
+                                                 c.col_offset))
+        if not any(line < first.lineno for line in consequence_lines):
+            findings.append(Finding(
+                analysis.path, first.lineno, first.col_offset, RULE,
+                NAME,
+                f"host-verdict fn {node.name!r} settles futures "
+                f"({_call_name(first)}) before any declared "
+                "consequence "
+                f"({', '.join(sorted(consequences)) or 'none declared'}"
+                ") — a woken caller can re-submit into the dead lane; "
+                "quarantine/failover must land first"))
+    return findings

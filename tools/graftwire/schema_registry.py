@@ -1,0 +1,96 @@
+"""Static view of `raft_tpu/serving/schema.py` — the wire/metrics
+registry W6 checks emissions and method names against.
+
+The registry values are `frozenset({...})` calls, so the file is not
+`literal_eval`-able; we walk the module AST and pull the KEY constants
+out of the `EVENT_FIELDS` / `WIRE_METHODS` dict literals. Parsed once
+per (path, digest) and memoized — schema edits change the digest,
+which also feeds the tier's cache signature so stale cached W6 results
+die with it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+from tools import lintcache
+
+SCHEMA_REL = os.path.join("raft_tpu", "serving", "schema.py")
+
+_memo: Dict[str, "SchemaRegistry"] = {}
+
+
+@dataclass
+class SchemaRegistry:
+    path: str
+    digest: str
+    events: Set[str] = field(default_factory=set)
+    methods: Set[str] = field(default_factory=set)
+
+    def event_declared(self, match) -> bool:
+        """`match` is WireAnalysis's ("exact", name) / ("prefix", p)."""
+        kind, value = match
+        if kind == "exact":
+            return value in self.events
+        return any(e.startswith(value) for e in self.events)
+
+
+def find_schema(start: str) -> Optional[str]:
+    """Walk up from `start` (a scanned file) to the repo root holding
+    serving/schema.py; fall back to the current working directory so
+    fixture copies under tmp dirs still resolve the REAL registry."""
+    cur = os.path.dirname(os.path.abspath(start))
+    for _ in range(12):
+        cand = os.path.join(cur, SCHEMA_REL)
+        if os.path.isfile(cand):
+            return cand
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            break
+        cur = nxt
+    cand = os.path.join(os.getcwd(), SCHEMA_REL)
+    return cand if os.path.isfile(cand) else None
+
+
+def load(path: Optional[str]) -> Optional[SchemaRegistry]:
+    if path is None:
+        return None
+    digest = lintcache.file_digest(path)
+    key = f"{os.path.abspath(path)}:{digest}"
+    if key in _memo:
+        return _memo[key]
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    reg = SchemaRegistry(path=path, digest=digest)
+    for node in tree.body:
+        # both plain and annotated assignments (`EVENT_FIELDS: Dict[
+        # str, frozenset] = {...}` is an AnnAssign)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            name = node.target.id
+        else:
+            continue
+        if name not in ("EVENT_FIELDS", "WIRE_METHODS"):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        keys = {k.value for k in node.value.keys
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, str)}
+        if name == "EVENT_FIELDS":
+            reg.events = keys
+        else:
+            reg.methods = keys
+    _memo[key] = reg
+    return reg
+
+
+def registry_for(start: str) -> Optional[SchemaRegistry]:
+    return load(find_schema(start))

@@ -44,7 +44,7 @@ from typing import (Callable, Dict, Iterable, List, Optional, Sequence,
 EXCLUDED_DIRS = {"__pycache__", ".git", "graftlint_fixtures",
                  "graftaudit_fixtures", "graftthread_fixtures",
                  "graftshard_fixtures", "graftexport_fixtures",
-                 "node_modules", ".venv"}
+                 "graftwire_fixtures", "node_modules", ".venv"}
 
 
 def collect_files(paths: Sequence[str],
